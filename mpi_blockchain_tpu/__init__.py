@@ -1,0 +1,19 @@
+"""mpi_blockchain_tpu — TPU-native rebuild of CatOfTheCannals/MPI_blockchain.
+
+A proof-of-work blockchain framework where the per-rank MPI nonce search of
+the reference becomes a vmapped/Pallas SHA-256 sweep on TPU, and the MPI
+broadcast/allreduce collectives become XLA ICI collectives over a
+``jax.sharding.Mesh`` (BASELINE.json north-star; SURVEY.md §7).
+
+Layout:
+  core/      C++ chain kernel (sha256, Block, Chain, Node) via ctypes
+  backend/   miner_backend plugin boundary: {cpu, tpu}
+  ops/       device sha256d sweep kernels (pure-jnp and Pallas)
+  parallel/  mesh construction + winner-select collectives
+  models/    the Miner driver (flagship jittable mine step)
+  utils/     logging, profiling, serialization helpers
+"""
+
+__version__ = "0.1.0"
+
+from .config import MinerConfig, PRESETS  # noqa: F401

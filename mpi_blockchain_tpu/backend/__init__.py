@@ -1,0 +1,66 @@
+"""The miner_backend plugin boundary (BASELINE.json north-star).
+
+Every backend implements the same deterministic contract: return the LOWEST
+nonce in [start_nonce, start_nonce + count) whose double-SHA256 header hash
+has >= difficulty_bits leading zero bits. Lowest-nonce (not first-found
+wall-clock) is what makes CPU, single-chip TPU, and 8-chip mesh runs produce
+identical block hashes (SURVEY.md §7 hard part #3).
+"""
+from __future__ import annotations
+
+import abc
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class SearchResult:
+    nonce: int | None        # lowest qualifying nonce, or None
+    hash: bytes | None       # 32-byte sha256d digest of the winning header
+    hashes_tried: int        # total nonces evaluated (for hashes/sec metrics)
+
+
+class MinerBackend(abc.ABC):
+    """Abstract nonce-search engine behind the plugin boundary."""
+
+    name: str = "abstract"
+
+    @abc.abstractmethod
+    def search(self, header80: bytes, difficulty_bits: int,
+               start_nonce: int = 0,
+               max_count: int = 1 << 32) -> SearchResult:
+        """Finds the lowest qualifying nonce in the given range."""
+
+
+_REGISTRY: dict[str, type[MinerBackend]] = {}
+
+
+def register(name: str):
+    def deco(cls: type[MinerBackend]):
+        cls.name = name
+        _REGISTRY[name] = cls
+        return cls
+    return deco
+
+
+def get_backend(name: str, **kwargs) -> MinerBackend:
+    """Instantiates a registered backend: get_backend("cpu"|"tpu", ...)."""
+    # Import lazily so the cpu path never drags in jax.
+    if name not in _REGISTRY:
+        if name == "cpu":
+            from . import cpu  # noqa: F401
+        elif name == "tpu":
+            from . import tpu  # noqa: F401
+    try:
+        return _REGISTRY[name](**kwargs)
+    except KeyError:
+        raise ValueError(f"unknown miner_backend {name!r}; "
+                         f"known: {sorted(_REGISTRY)}") from None
+
+
+def available() -> list[str]:
+    from . import cpu  # noqa: F401
+    try:
+        from . import tpu  # noqa: F401
+    except Exception:   # jax missing/broken — cpu still works
+        pass
+    return sorted(_REGISTRY)

@@ -1,0 +1,97 @@
+"""TPU miner_backend: jit'd batched nonce sweeps on one or more chips.
+
+Replaces the reference's per-rank scalar loop + MPI collectives with one jit'd
+XLA program per sweep round (SURVEY.md §3.4): the host sees only
+(count, min_nonce) per round; with n_miners > 1 the sweep runs under
+shard_map over the 'miners' mesh axis and the winner-select pmin/psum ride
+the ICI (parallel/mesh.py) — the TPU-native form of first-finder MPI_Bcast +
+height allreduce.
+
+Early exit under jit: rounds cover contiguous ranges [base, base + R) from
+start_nonce upward, so the first round containing any qualifier yields the
+exact global lowest nonce — deterministic and backend-independent.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import core
+from . import MinerBackend, SearchResult, register
+
+NONCE_SPACE = 1 << 32
+
+
+@register("tpu")
+class TpuBackend(MinerBackend):
+    def __init__(self, batch_pow2: int = 20, n_miners: int = 1,
+                 kernel: str = "auto", mesh=None):
+        import jax  # deferred so cpu-only users never import jax
+
+        self.batch_size = 1 << batch_pow2
+        self.n_miners = n_miners
+        self.kernel = kernel
+        self._sweeps: dict[int, object] = {}  # difficulty -> compiled fn
+        if n_miners > 1:
+            from ..parallel.mesh import MeshSweeper
+            self._mesh_sweeper = MeshSweeper(n_miners=n_miners,
+                                             batch_size=self.batch_size,
+                                             kernel=kernel, mesh=mesh)
+        else:
+            self._mesh_sweeper = None
+        self._jax = jax
+
+    # ---- kernel selection -------------------------------------------------
+
+    def _single_sweep(self, difficulty_bits: int):
+        fn = self._sweeps.get(difficulty_bits)
+        if fn is None:
+            from ..ops import select_kernel
+            fn, self.effective_kernel = select_kernel(
+                self.kernel, self.batch_size, difficulty_bits)
+            self._sweeps[difficulty_bits] = fn
+        return fn
+
+    # ---- the plugin contract ---------------------------------------------
+
+    def search(self, header80: bytes, difficulty_bits: int,
+               start_nonce: int = 0, max_count: int = NONCE_SPACE
+               ) -> SearchResult:
+        midstate, tail = core.header_midstate(header80)
+        end = min(start_nonce + max_count, NONCE_SPACE)
+        round_size = self.batch_size * self.n_miners
+        tried = 0
+        base = start_nonce
+        while base < end:
+            # The device sweeps full batches (static shapes). A final round
+            # that would wrap past 2^32 could surface a wrapped low nonce
+            # from *unswept* space and shadow a genuine in-range winner, so
+            # that partial tail (< round_size nonces) runs on the CPU oracle
+            # instead.
+            if base + round_size > NONCE_SPACE:
+                nonce, t = core.cpu_search(header80, base, end - base,
+                                           difficulty_bits)
+                tried += t
+                if nonce is not None:
+                    winner = core.set_nonce(header80, nonce)
+                    return SearchResult(nonce, core.header_hash(winner),
+                                        tried)
+                break
+            if self._mesh_sweeper is not None:
+                count, min_nonce = self._mesh_sweeper.sweep(
+                    midstate, tail, base, difficulty_bits)
+            else:
+                fn = self._single_sweep(difficulty_bits)
+                count, min_nonce = fn(midstate, tail,
+                                      np.uint32(base))
+            count = int(count)
+            min_nonce = int(min_nonce)
+            tried += min(round_size, end - base)
+            # min_nonce >= end can only be an overshoot past the requested
+            # range (never a wrap: wrapping rounds were handled above).
+            if count > 0 and base <= min_nonce < end:
+                winner = core.set_nonce(header80, min_nonce)
+                return SearchResult(min_nonce, core.header_hash(winner), tried)
+            base += round_size
+        return SearchResult(None, None, tried)
+
+

@@ -1,0 +1,134 @@
+"""Command-line entry point — the rebuild of the reference's main()/mpirun
+launch form (SURVEY.md §1 layer 7).
+
+    python -m mpi_blockchain_tpu mine --difficulty 16 --blocks 10 --backend cpu
+    python -m mpi_blockchain_tpu mine --preset tpu-single
+    python -m mpi_blockchain_tpu verify --chain chain.bin --difficulty 16
+
+Where the reference took `mpirun -np N`, the miner count here is --miners N:
+CPU ranks for backend=cpu, mesh devices for backend=tpu.
+"""
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+import time
+
+from .config import MinerConfig, PRESETS
+
+
+def _add_config_args(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--preset", choices=sorted(PRESETS),
+                   help="named BASELINE config (overrides other flags)")
+    p.add_argument("--difficulty", type=int, default=16,
+                   help="leading-zero bits (default 16)")
+    p.add_argument("--blocks", type=int, default=10)
+    p.add_argument("--miners", type=int, default=1,
+                   help="CPU ranks / mesh devices (mpirun -np equivalent)")
+    p.add_argument("--backend", choices=["cpu", "tpu"], default="cpu")
+    p.add_argument("--kernel", choices=["auto", "jnp", "pallas"],
+                   default="auto")
+    p.add_argument("--batch-pow2", type=int, default=20,
+                   help="log2 nonces per device per round")
+
+
+def _config_from(args) -> MinerConfig:
+    if args.preset:
+        return PRESETS[args.preset]
+    return MinerConfig(difficulty_bits=args.difficulty, n_blocks=args.blocks,
+                       batch_pow2=args.batch_pow2, n_miners=args.miners,
+                       backend=args.backend, kernel=args.kernel)
+
+
+def cmd_mine(args) -> int:
+    from .models.miner import Miner
+    from .utils.logging import get_logger
+
+    cfg = _config_from(args)
+    if args.verbose:
+        get_logger().setLevel("DEBUG")
+    miner = Miner(cfg)
+    t0 = time.perf_counter()
+    miner.mine_chain()
+    wall = time.perf_counter() - t0
+    if args.out:
+        with open(args.out, "wb") as f:
+            f.write(miner.node.save())
+    summary = {
+        "event": "chain_mined",
+        "config": dataclasses.asdict(cfg),
+        "height": miner.node.height,
+        "tip_hash": miner.node.tip_hash.hex(),
+        "wall_s": round(wall, 3),
+        "hashes_tried": miner.total_hashes(),
+        "hashes_per_sec": round(miner.hashes_per_sec()),
+        "backend": miner.backend.name,
+    }
+    print(json.dumps(summary, sort_keys=True))
+    return 0
+
+
+def cmd_verify(args) -> int:
+    """Validates a saved chain file (PoW + linkage + determinism rules)."""
+    from . import core
+
+    try:
+        with open(args.chain, "rb") as f:
+            blob = f.read()
+    except OSError as e:
+        print(json.dumps({"event": "chain_verified", "valid": False,
+                          "error": str(e)}, sort_keys=True))
+        return 1
+    node = core.Node(args.difficulty, 0)
+    ok = node.load(blob)
+    print(json.dumps({
+        "event": "chain_verified", "valid": bool(ok),
+        "height": node.height if ok else None,
+        "tip_hash": node.tip_hash.hex() if ok else None,
+    }, sort_keys=True))
+    return 0 if ok else 1
+
+
+def cmd_bench(args) -> int:
+    from .bench_lib import run_bench
+
+    result = run_bench(backend=args.backend, seconds=args.seconds,
+                       batch_pow2=args.batch_pow2, n_miners=args.miners,
+                       kernel=args.kernel)
+    print(json.dumps(result, sort_keys=True))
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="mpi_blockchain_tpu")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p_mine = sub.add_parser("mine", help="mine a chain")
+    _add_config_args(p_mine)
+    p_mine.add_argument("--out", help="write the chain to this file")
+    p_mine.add_argument("--verbose", action="store_true",
+                        help="per-block JSON lines")
+    p_mine.set_defaults(fn=cmd_mine)
+
+    p_verify = sub.add_parser("verify", help="validate a saved chain file")
+    p_verify.add_argument("--chain", required=True)
+    p_verify.add_argument("--difficulty", type=int, required=True)
+    p_verify.set_defaults(fn=cmd_verify)
+
+    p_bench = sub.add_parser("bench", help="raw hashes/sec measurement")
+    p_bench.add_argument("--backend", choices=["cpu", "tpu"], default="tpu")
+    p_bench.add_argument("--seconds", type=float, default=5.0)
+    p_bench.add_argument("--batch-pow2", type=int, default=20)
+    p_bench.add_argument("--miners", type=int, default=1)
+    p_bench.add_argument("--kernel", choices=["auto", "jnp", "pallas"],
+                         default="auto")
+    p_bench.set_defaults(fn=cmd_bench)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,47 @@
+"""Configuration for mining runs.
+
+One dataclass + the five BASELINE.json eval configs as named presets
+(SURVEY.md §5 "Config/flag system").
+"""
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class MinerConfig:
+    difficulty_bits: int = 16
+    n_blocks: int = 10
+    batch_pow2: int = 20          # log2(per-device nonces per sweep round)
+    n_miners: int = 1             # mesh axis size (devices or CPU ranks)
+    backend: str = "tpu"          # miner_backend plugin: {"cpu", "tpu"}
+    kernel: str = "auto"          # tpu sweep kernel: {"auto", "jnp", "pallas"}
+    seed: int = 0                 # reserved (search is deterministic)
+    data_prefix: str = "block"    # payload = f"{data_prefix}:{height}"
+
+    @property
+    def batch_size(self) -> int:
+        return 1 << self.batch_pow2
+
+    def payload(self, height: int) -> bytes:
+        return f"{self.data_prefix}:{height}".encode()
+
+
+# The five BASELINE.json eval configs (SURVEY.md §6 measurement matrix).
+PRESETS: dict[str, MinerConfig] = {
+    # 1: single-rank CPU mine: 10 blocks, difficulty=16, fixed genesis
+    "cpu-single": MinerConfig(difficulty_bits=16, n_blocks=10, n_miners=1,
+                              backend="cpu"),
+    # 2: 4 CPU ranks, difficulty=20, first-finder broadcast
+    "cpu-np4": MinerConfig(difficulty_bits=20, n_blocks=10, n_miners=4,
+                           backend="cpu"),
+    # 3: TPU single-chip Pallas SHA-256, nonce-batch=2^20, difficulty=20
+    "tpu-single": MinerConfig(difficulty_bits=20, n_blocks=10, batch_pow2=20,
+                              n_miners=1, backend="tpu", kernel="pallas"),
+    # 4: v5e-8 data-parallel nonce-space split, difficulty=24
+    "tpu-mesh8": MinerConfig(difficulty_bits=24, n_blocks=1000, batch_pow2=20,
+                             n_miners=8, backend="tpu"),
+    # 5: adversarial: 2 competing miner groups + longest-chain reorg
+    "adversarial": MinerConfig(difficulty_bits=16, n_blocks=20, n_miners=2,
+                               backend="tpu"),
+}

@@ -1,0 +1,20 @@
+"""Builds libchaincore.so on demand (first import) via the Makefile."""
+from __future__ import annotations
+
+import pathlib
+import subprocess
+
+_CORE_DIR = pathlib.Path(__file__).resolve().parent
+_LIB = _CORE_DIR / "libchaincore.so"
+_SRC = _CORE_DIR / "src"
+
+
+def ensure_built() -> pathlib.Path:
+    """Compiles the C++ core if the .so is missing or older than any source."""
+    if _LIB.exists():
+        lib_mtime = _LIB.stat().st_mtime
+        stale = any(p.stat().st_mtime > lib_mtime for p in _SRC.iterdir())
+        if not stale:
+            return _LIB
+    subprocess.run(["make", "-s"], cwd=_CORE_DIR, check=True)
+    return _LIB

@@ -1,0 +1,163 @@
+// extern "C" boundary for ctypes (the Python <-> C++ binding layer).
+//
+// BASELINE.json's north-star names pybind11 for this boundary; pybind11 is
+// not available in this offline image, so the spec'd plugin boundary is
+// realized with the CPython-agnostic C ABI + ctypes (SURVEY.md §7 hard part
+// #7 explicitly sanctions this fallback). The architecture is unchanged: the
+// C++ Block/Node classes remain the canonical chain state; Python sees only
+// opaque Node handles, 80-byte serialized headers, and 32-byte digests.
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+#include "chain.hpp"
+#include "sha256.hpp"
+
+using namespace chaincore;
+
+extern "C" {
+
+// ---------- hashing primitives ----------
+
+void cc_sha256(const uint8_t* data, uint64_t len, uint8_t out[32]) {
+  sha256(data, len, out);
+}
+
+void cc_sha256d(const uint8_t* data, uint64_t len, uint8_t out[32]) {
+  sha256d(data, len, out);
+}
+
+void cc_header_hash(const uint8_t header80[80], uint8_t out[32]) {
+  sha256d(header80, kHeaderSize, out);
+}
+
+int cc_leading_zero_bits(const uint8_t h[32]) { return leading_zero_bits(h); }
+
+// Midstate + chunk-2 word template for an 80-byte header (see sha256.hpp).
+void cc_header_midstate(const uint8_t header80[80], uint32_t out_state[8],
+                        uint32_t out_tail_w[16]) {
+  header_midstate(header80, out_state, out_tail_w);
+}
+
+// ---------- CPU nonce search (the cpu miner_backend) ----------
+
+// Sequential lowest-nonce-first sweep over [start_nonce, start_nonce+count),
+// clamped to the uint32 nonce space. Returns the first (== lowest) nonce
+// whose double-SHA256 header hash has >= difficulty_bits leading zero bits,
+// or UINT64_MAX if none in range. This "lowest qualifying nonce" rule is the
+// deterministic winner rule every backend implements, so CPU and TPU produce
+// identical block hashes (BASELINE.json north-star requirement).
+uint64_t cc_search(const uint8_t header80[80], uint64_t start_nonce,
+                   uint64_t count, uint32_t difficulty_bits,
+                   uint64_t* hashes_tried) {
+  uint32_t midstate[8], tail[16];
+  header_midstate(header80, midstate, tail);
+  uint64_t end = start_nonce + count;
+  if (end > 0x100000000ULL) end = 0x100000000ULL;
+  uint64_t tried = 0;
+  for (uint64_t n = start_nonce; n < end; ++n, ++tried) {
+    // The header stores the nonce little-endian; SHA words are big-endian
+    // reads of the stream, so word 3 = bswap32(nonce).
+    tail[3] = ((uint32_t(n) & 0xff) << 24) | ((uint32_t(n) & 0xff00) << 8) |
+              ((uint32_t(n) >> 8) & 0xff00) | (uint32_t(n) >> 24);
+    uint8_t digest[32];
+    sha256d_from_midstate(midstate, tail, digest);
+    if (leading_zero_bits(digest) >= int(difficulty_bits)) {
+      if (hashes_tried) *hashes_tried = tried + 1;
+      return n;
+    }
+  }
+  if (hashes_tried) *hashes_tried = tried;
+  return UINT64_MAX;
+}
+
+// ---------- Node / Chain object API ----------
+
+void* cc_node_new(uint32_t difficulty_bits, int node_id) {
+  return new Node(difficulty_bits, node_id);
+}
+
+void cc_node_free(void* node) { delete static_cast<Node*>(node); }
+
+uint64_t cc_node_height(void* node) {
+  return static_cast<Node*>(node)->height();
+}
+
+uint32_t cc_node_difficulty(void* node) {
+  return static_cast<Node*>(node)->chain().difficulty_bits();
+}
+
+void cc_node_tip_hash(void* node, uint8_t out[32]) {
+  std::memcpy(out, static_cast<Node*>(node)->chain().tip().hash, 32);
+}
+
+void cc_node_block_hash(void* node, uint64_t height, uint8_t out[32]) {
+  const Chain& c = static_cast<Node*>(node)->chain();
+  if (height > c.height()) {  // defense in depth; Python raises first
+    std::memset(out, 0, 32);
+    return;
+  }
+  std::memcpy(out, c.at(height).hash, 32);
+}
+
+void cc_node_block_header(void* node, uint64_t height, uint8_t out80[80]) {
+  const Chain& c = static_cast<Node*>(node)->chain();
+  if (height > c.height()) {
+    std::memset(out80, 0, kHeaderSize);
+    return;
+  }
+  c.at(height).header.serialize(out80);
+}
+
+void cc_node_make_candidate(void* node, const uint8_t* data, uint64_t len,
+                            uint8_t out80[80]) {
+  static_cast<Node*>(node)->make_candidate(data, len).serialize(out80);
+}
+
+// Returns 1 on success (validated + appended), 0 otherwise.
+int cc_node_submit(void* node, const uint8_t header80[80]) {
+  return static_cast<Node*>(node)->submit(BlockHeader::deserialize(header80))
+             ? 1
+             : 0;
+}
+
+// Returns the RecvResult enum value.
+int cc_node_receive(void* node, const uint8_t header80[80]) {
+  return int(static_cast<Node*>(node)->on_block_received(
+      BlockHeader::deserialize(header80)));
+}
+
+// headers = n concatenated 80-byte headers for heights 1..n.
+// Returns the RecvResult enum value (kReorged on adoption).
+int cc_node_adopt_chain(void* node, const uint8_t* headers, uint64_t n) {
+  std::vector<BlockHeader> hs;
+  hs.reserve(n);
+  for (uint64_t i = 0; i < n; ++i)
+    hs.push_back(BlockHeader::deserialize(headers + i * kHeaderSize));
+  return int(static_cast<Node*>(node)->adopt_chain(hs));
+}
+
+// Writes the whole chain (genesis..tip) as concatenated headers into `out`
+// (caller allocates (height+1)*80 bytes). Returns the number of headers.
+uint64_t cc_node_save(void* node, uint8_t* out) {
+  std::vector<uint8_t> bytes = static_cast<Node*>(node)->chain().save();
+  std::memcpy(out, bytes.data(), bytes.size());
+  return bytes.size() / kHeaderSize;
+}
+
+// Restores chain state from concatenated headers (validates everything).
+// Returns 1 on success.
+int cc_node_load(void* node, const uint8_t* bytes, uint64_t n_headers) {
+  Node* nd = static_cast<Node*>(node);
+  std::vector<uint8_t> buf(bytes, bytes + n_headers * kHeaderSize);
+  Chain fresh(nd->chain().difficulty_bits());
+  if (!Chain::load(buf, nd->chain().difficulty_bits(), &fresh)) return 0;
+  nd->mutable_chain() = std::move(fresh);
+  return 1;
+}
+
+void cc_node_rollback(void* node, uint64_t new_height) {
+  static_cast<Node*>(node)->mutable_chain().rollback_to(new_height);
+}
+
+}  // extern "C"

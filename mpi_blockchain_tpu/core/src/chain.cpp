@@ -1,0 +1,166 @@
+#include "chain.hpp"
+
+#include "sha256.hpp"
+
+namespace chaincore {
+
+namespace {
+inline void store_le32(uint8_t* p, uint32_t v) {
+  p[0] = uint8_t(v);
+  p[1] = uint8_t(v >> 8);
+  p[2] = uint8_t(v >> 16);
+  p[3] = uint8_t(v >> 24);
+}
+inline uint32_t load_le32(const uint8_t* p) {
+  return uint32_t(p[0]) | (uint32_t(p[1]) << 8) | (uint32_t(p[2]) << 16) |
+         (uint32_t(p[3]) << 24);
+}
+}  // namespace
+
+void BlockHeader::serialize(uint8_t out[kHeaderSize]) const {
+  store_le32(out, version);
+  std::memcpy(out + 4, prev_hash, 32);
+  std::memcpy(out + 36, data_hash, 32);
+  store_le32(out + 68, timestamp);
+  store_le32(out + 72, bits);
+  store_le32(out + 76, nonce);
+}
+
+BlockHeader BlockHeader::deserialize(const uint8_t in[kHeaderSize]) {
+  BlockHeader h;
+  h.version = load_le32(in);
+  std::memcpy(h.prev_hash, in + 4, 32);
+  std::memcpy(h.data_hash, in + 36, 32);
+  h.timestamp = load_le32(in + 68);
+  h.bits = load_le32(in + 72);
+  h.nonce = load_le32(in + 76);
+  return h;
+}
+
+void BlockHeader::hash(uint8_t out[32]) const {
+  uint8_t buf[kHeaderSize];
+  serialize(buf);
+  sha256d(buf, kHeaderSize, out);
+}
+
+bool BlockHeader::meets_difficulty() const {
+  uint8_t h[32];
+  hash(h);
+  return leading_zero_bits(h) >= int(bits);
+}
+
+Block Block::from_header(const BlockHeader& h, uint64_t height) {
+  Block b;
+  b.header = h;
+  b.height = height;
+  h.hash(b.hash);
+  return b;
+}
+
+Chain::Chain(uint32_t difficulty_bits) : difficulty_bits_(difficulty_bits) {
+  BlockHeader genesis;
+  genesis.version = kVersion;
+  // prev_hash stays all-zero.
+  static const char kGenesisPayload[] = "genesis";
+  sha256d(reinterpret_cast<const uint8_t*>(kGenesisPayload),
+          sizeof(kGenesisPayload) - 1, genesis.data_hash);
+  genesis.timestamp = 0;
+  genesis.bits = difficulty_bits;
+  genesis.nonce = 0;
+  blocks_.push_back(Block::from_header(genesis, 0));
+}
+
+bool Chain::valid_child(const BlockHeader& header, const Block& parent) const {
+  if (header.version != kVersion) return false;
+  if (std::memcmp(header.prev_hash, parent.hash, 32) != 0) return false;
+  if (header.timestamp != uint32_t(parent.height + 1)) return false;
+  if (header.bits != difficulty_bits_) return false;
+  return header.meets_difficulty();
+}
+
+bool Chain::append(const BlockHeader& header) {
+  if (!valid_child(header, tip())) return false;
+  blocks_.push_back(Block::from_header(header, height() + 1));
+  return true;
+}
+
+bool Chain::try_adopt(const std::vector<BlockHeader>& headers) {
+  if (headers.size() <= height()) return false;  // not strictly longer
+  // Validate the candidate chain above our genesis.
+  const Block* parent = &blocks_[0];
+  std::vector<Block> candidate;
+  candidate.reserve(headers.size());
+  for (const BlockHeader& h : headers) {
+    if (!valid_child(h, *parent)) return false;
+    candidate.push_back(Block::from_header(h, parent->height + 1));
+    parent = &candidate.back();
+  }
+  blocks_.resize(1);  // keep genesis
+  blocks_.insert(blocks_.end(), candidate.begin(), candidate.end());
+  return true;
+}
+
+void Chain::rollback_to(uint64_t new_height) {
+  if (new_height + 1 < blocks_.size()) blocks_.resize(new_height + 1);
+}
+
+std::vector<uint8_t> Chain::save() const {
+  std::vector<uint8_t> out(blocks_.size() * kHeaderSize);
+  for (size_t i = 0; i < blocks_.size(); ++i)
+    blocks_[i].header.serialize(out.data() + i * kHeaderSize);
+  return out;
+}
+
+bool Chain::load(const std::vector<uint8_t>& bytes, uint32_t difficulty_bits,
+                 Chain* out) {
+  if (bytes.empty() || bytes.size() % kHeaderSize != 0) return false;
+  Chain fresh(difficulty_bits);
+  // Byte 0..79 must be exactly our deterministic genesis.
+  uint8_t genesis_buf[kHeaderSize];
+  fresh.blocks_[0].header.serialize(genesis_buf);
+  if (std::memcmp(bytes.data(), genesis_buf, kHeaderSize) != 0) return false;
+  size_t n = bytes.size() / kHeaderSize;
+  std::vector<BlockHeader> rest;
+  rest.reserve(n - 1);
+  for (size_t i = 1; i < n; ++i)
+    rest.push_back(BlockHeader::deserialize(bytes.data() + i * kHeaderSize));
+  if (!rest.empty() && !fresh.try_adopt(rest)) return false;
+  *out = std::move(fresh);
+  return true;
+}
+
+BlockHeader Node::make_candidate(const uint8_t* data, size_t len) const {
+  BlockHeader h;
+  h.version = kVersion;
+  std::memcpy(h.prev_hash, chain_.tip().hash, 32);
+  sha256d(data, len, h.data_hash);
+  h.timestamp = uint32_t(chain_.height() + 1);
+  h.bits = chain_.difficulty_bits();
+  h.nonce = 0;
+  return h;
+}
+
+bool Node::submit(const BlockHeader& header) { return chain_.append(header); }
+
+RecvResult Node::on_block_received(const BlockHeader& header) {
+  uint8_t h[32];
+  header.hash(h);
+  if (std::memcmp(h, chain_.tip().hash, 32) == 0) return RecvResult::kDuplicate;
+  if (std::memcmp(header.prev_hash, chain_.tip().hash, 32) == 0) {
+    return chain_.append(header) ? RecvResult::kAppended : RecvResult::kInvalid;
+  }
+  // Does not extend our tip. If it matches an existing block, duplicate;
+  // otherwise the caller must fetch the sender's chain for longest-chain
+  // resolution (SURVEY.md §3.3).
+  for (uint64_t i = 0; i <= chain_.height(); ++i)
+    if (std::memcmp(chain_.at(i).hash, h, 32) == 0) return RecvResult::kDuplicate;
+  return RecvResult::kStaleOrFork;
+}
+
+RecvResult Node::adopt_chain(const std::vector<BlockHeader>& headers) {
+  if (headers.size() <= chain_.height()) return RecvResult::kIgnoredShorter;
+  return chain_.try_adopt(headers) ? RecvResult::kReorged
+                                   : RecvResult::kInvalid;
+}
+
+}  // namespace chaincore

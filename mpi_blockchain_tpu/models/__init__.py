@@ -1,0 +1,6 @@
+"""Miner drivers — the framework's 'model' layer.
+
+The flagship computation is the jit'd sha256d nonce sweep (ops/) driven by
+the Miner loop here; chain state stays in the C++ core (core/).
+"""
+from .miner import Miner, BlockRecord  # noqa: F401

@@ -1,0 +1,96 @@
+"""The Miner: drives candidate construction, backend search, and chain append.
+
+Mirrors the reference's Node::run mine loop (SURVEY.md §3.2) with the
+boundaries moved per §3.4: the hot nonce loop lives in one jit'd device
+program per round; the host only appends winners. Chain state is canonical in
+the C++ Node; the search runs behind the miner_backend plugin boundary.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable
+
+from .. import core
+from ..backend import MinerBackend, get_backend
+from ..config import MinerConfig
+from ..utils.logging import block_logger
+
+
+@dataclasses.dataclass(frozen=True)
+class BlockRecord:
+    """Structured per-block mining record (SURVEY.md §5 observability)."""
+    height: int
+    nonce: int
+    hash: str
+    wall_ms: float
+    hashes_tried: int
+
+    @property
+    def hashes_per_sec(self) -> float:
+        return self.hashes_tried / max(self.wall_ms / 1e3, 1e-9)
+
+
+class Miner:
+    """One mining node: a C++ Node + a search backend."""
+
+    def __init__(self, config: MinerConfig, node_id: int = 0,
+                 backend: MinerBackend | None = None,
+                 log_fn: Callable[[dict], None] | None = None):
+        self.config = config
+        self.node = core.Node(config.difficulty_bits, node_id)
+        if backend is None:
+            if config.backend == "cpu":
+                backend = get_backend("cpu", n_ranks=config.n_miners,
+                                      batch_size=config.batch_size)
+            else:
+                backend = get_backend("tpu", batch_pow2=config.batch_pow2,
+                                      n_miners=config.n_miners,
+                                      kernel=config.kernel)
+        self.backend = backend
+        self.records: list[BlockRecord] = []
+        self._log = log_fn if log_fn is not None else block_logger()
+
+    def mine_block(self, data: bytes | None = None) -> BlockRecord:
+        """Mines and appends exactly one block on the current tip."""
+        height = self.node.height + 1
+        if data is None:
+            data = self.config.payload(height)
+        cand = self.node.make_candidate(data)
+        t0 = time.perf_counter()
+        res = self.backend.search(cand, self.config.difficulty_bits)
+        wall_ms = (time.perf_counter() - t0) * 1e3
+        if res.nonce is None:
+            raise RuntimeError(
+                f"nonce space exhausted at height {height} "
+                f"(difficulty {self.config.difficulty_bits})")
+        winner = core.set_nonce(cand, res.nonce)
+        if not self.node.submit(winner):
+            raise RuntimeError(f"backend returned invalid block at {height}")
+        rec = BlockRecord(height=height, nonce=res.nonce,
+                          hash=res.hash.hex(), wall_ms=wall_ms,
+                          hashes_tried=res.hashes_tried)
+        self.records.append(rec)
+        self._log({"event": "block_mined", "backend": self.backend.name,
+                   **dataclasses.asdict(rec)})
+        return rec
+
+    def mine_chain(self, n_blocks: int | None = None) -> list[BlockRecord]:
+        """Mines n_blocks on top of the current tip (config 1/3/4 driver)."""
+        n = n_blocks if n_blocks is not None else self.config.n_blocks
+        return [self.mine_block() for _ in range(n)]
+
+    # ---- aggregate metrics -------------------------------------------------
+
+    def total_hashes(self) -> int:
+        return sum(r.hashes_tried for r in self.records)
+
+    def total_wall_s(self) -> float:
+        return sum(r.wall_ms for r in self.records) / 1e3
+
+    def hashes_per_sec(self) -> float:
+        return self.total_hashes() / max(self.total_wall_s(), 1e-9)
+
+    def chain_hashes(self) -> list[str]:
+        return [self.node.block_hash(i).hex()
+                for i in range(self.node.height + 1)]

@@ -1,0 +1,53 @@
+"""Device-side sha256d sweep kernels.
+
+Two implementations of the same op, bit-exact with the C++ core:
+  sha256_jnp    — pure jax.numpy, fully XLA-fused (portable: cpu/tpu)
+  sha256_pallas — hand-tiled Pallas TPU kernel (VMEM-resident rounds)
+
+Both consume the midstate + chunk-2 word template produced by
+core.header_midstate, so the per-nonce cost is exactly two SHA-256
+compressions everywhere (SURVEY.md §7 step 5 midstate optimization).
+"""
+from __future__ import annotations
+
+import functools
+
+from .sha256_jnp import make_sweep_fn, sweep_core, sweep_jnp  # noqa: F401
+
+
+def select_kernel(kernel: str, batch_size: int, difficulty_bits: int,
+                  shard: bool = False):
+    """Resolves the sweep kernel policy in ONE place (backends + mesh).
+
+    kernel: {"auto", "jnp", "pallas"}; auto => pallas on a real TPU, jnp
+    elsewhere. Returns (fn, effective_kernel_name). With shard=False the fn
+    is jit'd and callable from the host; with shard=True it is the unjitted
+    core (midstate, tail_w, base) -> (count, min_nonce) for use inside
+    shard_map. Falls back from pallas to jnp with a visible warning (never
+    silently, so bench labels stay honest).
+    """
+    import jax
+
+    if kernel == "auto":
+        kernel = "pallas" if jax.default_backend() == "tpu" else "jnp"
+    if kernel == "pallas":
+        try:
+            from .sha256_pallas import (make_pallas_sweep_fn,
+                                        pallas_sweep_core)
+            if shard:
+                return functools.partial(
+                    pallas_sweep_core, batch_size=batch_size,
+                    difficulty_bits=difficulty_bits), "pallas"
+            return make_pallas_sweep_fn(batch_size, difficulty_bits), "pallas"
+        except Exception as e:  # pallas unavailable on this platform
+            from ..utils.logging import get_logger
+            get_logger().warning(
+                "pallas sweep kernel unavailable (%s: %s); falling back to "
+                "the jnp kernel", type(e).__name__, e)
+            kernel = "jnp"
+    if kernel != "jnp":
+        raise ValueError(f"unknown sweep kernel {kernel!r}")
+    if shard:
+        return (lambda ms, tw, base: sweep_core(
+            ms, tw, base, batch_size, difficulty_bits)), "jnp"
+    return make_sweep_fn(batch_size, difficulty_bits), "jnp"

@@ -1,0 +1,183 @@
+"""Pure-jnp vectorized double-SHA-256 nonce sweep.
+
+TPU-first design notes (SURVEY.md §7 step 4):
+  * Everything is uint32 vector ALU work on the VPU — there is no matmul in
+    SHA-256, so the MXU is idle by construction; the win over the CPU is the
+    (8,128)-lane vector unit sweeping a whole nonce batch per instruction.
+  * The 64 rounds x 2 compressions are Python-unrolled at trace time into a
+    flat chain of elementwise uint32 ops; XLA fuses the entire sweep into one
+    kernel, keeping all per-nonce state in registers/VMEM (HBM traffic is just
+    the nonce batch in and two scalars out).
+  * No data-dependent control flow: a fixed-size batch is swept, reduced to
+    (count, min qualifying nonce), and the host decides whether to continue —
+    the jit-compatible replacement for the reference's `break` (SURVEY.md §3.4).
+
+Bit-exactness contract: given the midstate/tail from core.header_midstate,
+this computes exactly sha256d(header) for each nonce, matching the C++
+sha256d_from_midstate.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# FIPS 180-4 round constants / IV (same values as core/src/sha256.cpp).
+K = np.array([
+    0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b, 0x59f111f1,
+    0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
+    0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174, 0xe49b69c1, 0xefbe4786,
+    0x0fc19dc6, 0x240ca1cc, 0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da,
+    0x983e5152, 0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+    0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc, 0x53380d13,
+    0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85, 0xa2bfe8a1, 0xa81a664b,
+    0xc24b8b70, 0xc76c51a3, 0xd192e819, 0xd6990624, 0xf40e3585, 0x106aa070,
+    0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a,
+    0x5b9cca4f, 0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+    0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2], dtype=np.uint32)
+
+IV = np.array([0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+               0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19],
+              dtype=np.uint32)
+
+_U32 = jnp.uint32
+NOT_FOUND_U32 = np.uint32(0xFFFFFFFF)
+
+
+def _rotr(x, n: int):
+    return (x >> np.uint32(n)) | (x << np.uint32(32 - n))
+
+
+def _bswap32(x):
+    return ((x & np.uint32(0xFF)) << np.uint32(24)) \
+         | ((x & np.uint32(0xFF00)) << np.uint32(8)) \
+         | ((x >> np.uint32(8)) & np.uint32(0xFF00)) \
+         | (x >> np.uint32(24))
+
+
+def compress(state, w, unroll: int = 8):
+    """One SHA-256 compression.
+
+    state: tuple/list of 8 uint32 arrays, all of one shape B
+    w:     list of 16 uint32 arrays (message words), each of shape B
+    Returns the 8 updated state words.
+
+    Implemented as two lax.scans (message schedule, then the 64 rounds) so
+    the traced graph stays tiny: a fully Python-unrolled version takes XLA's
+    CPU backend minutes to compile. `unroll` gives XLA straight-line chunks
+    to software-pipeline without exploding the graph.
+    """
+    shape = jnp.shape(w[3]) if jnp.ndim(w[3]) else ()
+    W16 = jnp.stack([jnp.broadcast_to(jnp.asarray(x, _U32), shape)
+                     for x in w])  # (16, *B)
+    # Under shard_map the nonce word varies over the mesh axis while the
+    # midstate/IV are replicated; xor-ing a varying zero into the scan carry
+    # makes its varying-axes type match the per-round outputs.
+    vzero = W16[3] & np.uint32(0)
+
+    def sched_step(window, _):
+        # window: the last 16 schedule words, (16, *B)
+        w15, w2 = window[1], window[14]
+        s0 = _rotr(w15, 7) ^ _rotr(w15, 18) ^ (w15 >> np.uint32(3))
+        s1 = _rotr(w2, 17) ^ _rotr(w2, 19) ^ (w2 >> np.uint32(10))
+        new = window[0] + s0 + window[9] + s1
+        return jnp.concatenate([window[1:], new[None]], axis=0), new
+
+    _, w_rest = jax.lax.scan(sched_step, W16, None, length=48, unroll=unroll)
+    W = jnp.concatenate([W16, w_rest], axis=0)  # (64, *B)
+
+    def round_step(carry, kw):
+        a, b, c, d, e, f, g, h = carry
+        k, wi = kw
+        S1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+        ch = (e & f) ^ (~e & g)
+        t1 = h + S1 + ch + k + wi
+        S0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+        maj = (a & b) ^ (a & c) ^ (b & c)
+        t2 = S0 + maj
+        return (t1 + t2, a, b, c, d + t1, e, f, g), None
+
+    st = tuple(jnp.broadcast_to(jnp.asarray(s, _U32), shape) ^ vzero
+               for s in state)
+    out, _ = jax.lax.scan(round_step, st, (jnp.asarray(K, _U32), W),
+                          unroll=unroll)
+    return tuple(o + s for o, s in zip(out, st))
+
+
+def sha256d_words_from_midstate(midstate, tail_w, nonce_word):
+    """Double-SHA256 digest words for a batch of nonces.
+
+    midstate:   (8,) uint32 — state after header chunk 1
+    tail_w:     (16,) uint32 — chunk-2 word template (word 3 ignored)
+    nonce_word: uint32 array, arbitrary shape B — ALREADY byte-swapped
+                (big-endian word of the little-endian nonce bytes)
+    Returns 8 uint32 arrays of shape B: the final digest words h0..h7
+    (digest bytes are their big-endian concatenation).
+    """
+    st = tuple(midstate[i] for i in range(8))
+    w = [tail_w[i] if i != 3 else nonce_word for i in range(16)]
+    d1 = compress(st, w)
+    # Second hash: digest-1 words are the message words directly (the digest
+    # bytes are their BE encoding, and SHA reads words BE — no swap).
+    zero = np.uint32(0)
+    w2 = list(d1) + [np.uint32(0x80000000),
+                     zero, zero, zero, zero, zero, zero,
+                     np.uint32(32 * 8)]
+    return compress(tuple(IV), w2)
+
+
+def difficulty_mask(digest_words, difficulty_bits: int):
+    """True where the 256-bit BE digest has >= difficulty_bits leading zeros.
+
+    difficulty_bits is static (compiled per difficulty). Supports 0..64,
+    which covers every BASELINE config (max 24) with headroom.
+    """
+    h0, h1 = digest_words[0], digest_words[1]
+    d = int(difficulty_bits)
+    if d <= 0:
+        return jnp.ones_like(h0, dtype=bool)
+    if d < 32:
+        return h0 < np.uint32(1 << (32 - d))
+    if d == 32:
+        return h0 == np.uint32(0)
+    if d < 64:
+        return (h0 == np.uint32(0)) & (h1 < np.uint32(1 << (64 - d)))
+    if d == 64:
+        return (h0 == np.uint32(0)) & (h1 == np.uint32(0))
+    raise ValueError(f"difficulty_bits {d} > 64 unsupported")
+
+
+def sweep_core(midstate, tail_w, base_nonce, batch_size: int,
+               difficulty_bits: int):
+    """Sweeps nonces [base_nonce, base_nonce + batch_size). Unjitted.
+
+    Returns (count, min_nonce): number of qualifying nonces in the batch and
+    the lowest one (0xFFFFFFFF when count == 0 — disambiguated by count, so
+    the real nonce 0xFFFFFFFF is handled correctly). Callable inside jit,
+    vmap, or shard_map (the mesh winner-select wraps exactly this).
+    """
+    nonces = jnp.asarray(base_nonce).astype(_U32) \
+        + jnp.arange(batch_size, dtype=_U32)
+    digest = sha256d_words_from_midstate(jnp.asarray(midstate).astype(_U32),
+                                         jnp.asarray(tail_w).astype(_U32),
+                                         _bswap32(nonces))
+    qual = difficulty_mask(digest, difficulty_bits)
+    count = jnp.sum(qual.astype(jnp.int32))
+    min_nonce = jnp.min(jnp.where(qual, nonces, NOT_FOUND_U32))
+    return count, min_nonce
+
+
+@functools.partial(jax.jit, static_argnames=("batch_size", "difficulty_bits"))
+def sweep_jnp(midstate, tail_w, base_nonce, *, batch_size: int,
+              difficulty_bits: int):
+    """jit'd single-device sweep (see sweep_core)."""
+    return sweep_core(midstate, tail_w, base_nonce, batch_size,
+                      difficulty_bits)
+
+
+def make_sweep_fn(batch_size: int, difficulty_bits: int):
+    """Returns sweep(midstate, tail_w, base_nonce) with static args bound."""
+    return functools.partial(sweep_jnp, batch_size=batch_size,
+                             difficulty_bits=difficulty_bits)

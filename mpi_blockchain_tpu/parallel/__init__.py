@@ -1,0 +1,8 @@
+"""Multi-chip parallelism: mesh construction + winner-select collectives.
+
+The TPU-native replacement for the reference's OpenMPI backend
+(SURVEY.md §5 "Distributed comm backend"): first-finder MPI_Bcast becomes a
+pmin winner-select inside the sharded sweep, height allreduce becomes a psum
+— both ride the ICI, with no cross-process boundary on a single host.
+"""
+from .mesh import MeshSweeper, make_miner_mesh  # noqa: F401

@@ -1,0 +1,86 @@
+"""Sharded nonce sweep over a 'miners' device mesh.
+
+Search-space data parallelism (SURVEY.md §2.3): round r covers the contiguous
+global range [base, base + n_miners*B); device i sweeps its B-sized slice
+(offset by jax.lax.axis_index). The collective epilogue —
+psum(local count) and pmin(local min qualifying nonce) — is the TPU-native
+replacement for the reference's first-finder MPI_Bcast + height allreduce:
+the pmin result is replicated to every device over the ICI, which *is* the
+broadcast. Deterministic winner = lowest qualifying nonce; ties are
+impossible (nonce ranges are disjoint), so no device-id tiebreak is needed.
+
+Multi-host scaling: the same shard_map program runs unchanged over a
+multi-host mesh (jax.distributed.initialize + all hosts executing the same
+program); XLA then routes the pmin/psum over ICI within a slice and DCN
+across slices. See parallel/distributed.py.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+_U32 = jnp.uint32
+
+
+def make_miner_mesh(n_miners: int) -> Mesh:
+    """A 1-D ('miners',) mesh over the first n_miners local devices."""
+    devices = jax.devices()
+    if len(devices) < n_miners:
+        raise ValueError(
+            f"need {n_miners} devices for the miners mesh, have "
+            f"{len(devices)} (tests: XLA_FLAGS="
+            f"--xla_force_host_platform_device_count={n_miners})")
+    return jax.make_mesh((n_miners,), ("miners",),
+                         devices=devices[:n_miners])
+
+
+def make_mesh_sweep_fn(mesh: Mesh, batch_size: int, difficulty_bits: int,
+                       kernel: str = "auto"):
+    """Builds the jit'd sharded sweep: (midstate, tail, base) -> (count, min).
+
+    All inputs are replicated; outputs are replicated scalars (the collective
+    epilogue reduces across 'miners'). One XLA program per round — the entire
+    mine-round including the "MPI" step is a single device computation.
+    """
+    from ..ops import select_kernel
+
+    sweep, _ = select_kernel(kernel, batch_size, difficulty_bits, shard=True)
+
+    def per_device(midstate, tail_w, base):
+        i = jax.lax.axis_index("miners").astype(_U32)
+        local_base = jnp.asarray(base).astype(_U32) + i * np.uint32(batch_size)
+        count, min_nonce = sweep(midstate, tail_w, local_base)
+        # Winner-select: the reference's MPI_Bcast/allreduce, as ICI
+        # collectives. min_nonce is 0xFFFFFFFF where count==0, so pmin
+        # directly yields the global lowest qualifying nonce.
+        total = jax.lax.psum(count, "miners")
+        gmin = jax.lax.pmin(min_nonce, "miners")
+        return total, gmin
+
+    sharded = jax.shard_map(per_device, mesh=mesh,
+                            in_specs=(P(), P(), P()), out_specs=(P(), P()))
+    return jax.jit(sharded)
+
+
+class MeshSweeper:
+    """Per-difficulty cache of jit'd sharded sweeps over one miners mesh."""
+
+    def __init__(self, n_miners: int, batch_size: int, kernel: str = "auto",
+                 mesh: Mesh | None = None):
+        self.mesh = mesh if mesh is not None else make_miner_mesh(n_miners)
+        self.n_miners = n_miners
+        self.batch_size = batch_size
+        self.kernel = kernel
+        self._fns: dict[int, object] = {}
+
+    def sweep(self, midstate, tail_w, base: int, difficulty_bits: int):
+        fn = self._fns.get(difficulty_bits)
+        if fn is None:
+            fn = make_mesh_sweep_fn(self.mesh, self.batch_size,
+                                    difficulty_bits, self.kernel)
+            self._fns[difficulty_bits] = fn
+        count, gmin = fn(jnp.asarray(midstate), jnp.asarray(tail_w),
+                         np.uint32(base))
+        return int(count), int(gmin)

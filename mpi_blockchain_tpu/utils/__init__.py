@@ -1,0 +1,2 @@
+"""Shared utilities: structured logging, profiling, checkpointing."""
+from .logging import block_logger, get_logger  # noqa: F401

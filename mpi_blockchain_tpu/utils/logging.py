@@ -1,0 +1,34 @@
+"""Structured logging (SURVEY.md §5 metrics/observability).
+
+The reference's std::cout prints become JSON-lines records: one dict per
+mined block {height, nonce, hash, wall_ms, hashes_tried}, emitted through
+Python logging so callers can redirect or silence them.
+"""
+from __future__ import annotations
+
+import json
+import logging
+from typing import Callable
+
+_LOGGER_NAME = "mpi_blockchain_tpu"
+
+
+def get_logger() -> logging.Logger:
+    logger = logging.getLogger(_LOGGER_NAME)
+    if not logger.handlers:
+        handler = logging.StreamHandler()
+        handler.setFormatter(logging.Formatter("%(message)s"))
+        logger.addHandler(handler)
+        logger.setLevel(logging.INFO)
+        logger.propagate = False
+    return logger
+
+
+def block_logger() -> Callable[[dict], None]:
+    """Returns a callable that logs one structured record as a JSON line."""
+    logger = get_logger()
+
+    def log(record: dict) -> None:
+        logger.debug(json.dumps(record, sort_keys=True))
+
+    return log

@@ -1,0 +1,21 @@
+"""Test environment: force JAX onto a virtual 8-device CPU platform.
+
+Multi-chip hardware is not available in CI; the sharding/collective paths are
+exercised on a faked 8-device CPU mesh (SURVEY.md §4.3). Must run before the
+first jax import, hence module scope in the root conftest.
+"""
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# The axon TPU site-hook re-forces JAX_PLATFORMS=axon after env setup; the
+# config knob wins over it, so set it explicitly as well.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert jax.devices()[0].platform == "cpu", jax.devices()

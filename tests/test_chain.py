@@ -1,0 +1,120 @@
+"""Chain/Node consensus state machine: append, receive, reorg, save/load."""
+from mpi_blockchain_tpu import core
+
+DIFF = 8  # fast CPU mining in tests
+
+
+def mine_on(node: core.Node, data: bytes) -> bytes:
+    cand = node.make_candidate(data)
+    nonce, _ = core.cpu_search(cand, 0, 1 << 32, node.difficulty_bits)
+    return core.set_nonce(cand, nonce)
+
+
+def test_submit_validates():
+    node = core.Node(DIFF, 0)
+    hdr = mine_on(node, b"a")
+    assert node.submit(hdr)
+    assert node.height == 1
+    # Resubmitting the same header fails (prev no longer matches tip).
+    assert not node.submit(hdr)
+    # Garbage nonce fails PoW.
+    bad = core.set_nonce(node.make_candidate(b"b"), 0)
+    digest = core.header_hash(bad)
+    if core.leading_zero_bits(digest) < DIFF:  # overwhelmingly likely
+        assert not node.submit(bad)
+
+
+def test_receive_extends_tip():
+    a, b = core.Node(DIFF, 0), core.Node(DIFF, 1)
+    hdr = mine_on(a, b"x")
+    assert a.submit(hdr)
+    assert b.receive(hdr) == core.RecvResult.APPENDED
+    assert b.tip_hash == a.tip_hash
+    assert b.receive(hdr) == core.RecvResult.DUPLICATE
+
+
+def test_receive_invalid_rejected():
+    a, b = core.Node(DIFF, 0), core.Node(DIFF, 1)
+    hdr = mine_on(a, b"x")
+    # Corrupt the timestamp (deterministic-timestamp rule).
+    bad = hdr[:68] + b"\x09\x00\x00\x00" + hdr[72:]
+    assert b.receive(bad) in (core.RecvResult.INVALID,
+                              core.RecvResult.STALE_OR_FORK)
+    assert b.height == 0
+
+
+def test_longest_chain_reorg():
+    a, b = core.Node(DIFF, 0), core.Node(DIFF, 1)
+    # a mines 1 block; b mines 3 different blocks — a fork.
+    a.submit(mine_on(a, b"a1"))
+    for payload in (b"b1", b"b2", b"b3"):
+        b.submit(mine_on(b, payload))
+    assert a.height == 1 and b.height == 3
+    # b's tip does not extend a's tip -> stale-or-fork -> fetch + adopt.
+    tip_b = b.block_header(b.height)
+    assert a.receive(tip_b) == core.RecvResult.STALE_OR_FORK
+    assert a.adopt_chain(b.all_headers()) == core.RecvResult.REORGED
+    assert a.height == 3 and a.tip_hash == b.tip_hash
+    # The reverse direction: b ignores a's (now shorter) chain.
+    assert b.adopt_chain([]) == core.RecvResult.IGNORED_SHORTER
+
+
+def test_adopt_rejects_invalid_chain():
+    a, b = core.Node(DIFF, 0), core.Node(DIFF, 1)
+    for payload in (b"b1", b"b2"):
+        b.submit(mine_on(b, payload))
+    headers = b.all_headers()
+    # Tamper with block 1's nonce: PoW almost surely breaks.
+    tampered = [core.set_nonce(headers[0], 12345), headers[1]]
+    if core.leading_zero_bits(core.header_hash(tampered[0])) < DIFF:
+        assert a.adopt_chain(tampered) == core.RecvResult.INVALID
+        assert a.height == 0
+
+
+def test_equal_length_keeps_first():
+    a, b = core.Node(DIFF, 0), core.Node(DIFF, 1)
+    a.submit(mine_on(a, b"a1"))
+    b.submit(mine_on(b, b"b1"))
+    # Equal heights: adoption requires strictly longer.
+    assert a.adopt_chain(b.all_headers()) == core.RecvResult.IGNORED_SHORTER
+    assert a.block_hash(1) != b.block_hash(1)
+
+
+def test_rollback():
+    a = core.Node(DIFF, 0)
+    for p in (b"1", b"2", b"3"):
+        a.submit(mine_on(a, p))
+    h2 = a.block_hash(2)
+    a.rollback(2)
+    assert a.height == 2 and a.tip_hash == h2
+
+
+def test_block_access_bounds():
+    import pytest
+    a = core.Node(DIFF, 0)
+    with pytest.raises(IndexError):
+        a.block_hash(1)
+    with pytest.raises(IndexError):
+        a.block_header(-1)
+
+
+def test_load_bad_length_rejected():
+    a = core.Node(DIFF, 0)
+    assert not a.load(b"")
+    assert not a.load(b"x" * 81)  # not a multiple of the header size
+
+
+def test_save_load_roundtrip():
+    a = core.Node(DIFF, 0)
+    for p in (b"1", b"2"):
+        a.submit(mine_on(a, p))
+    blob = a.save()
+    assert len(blob) == 3 * core.HEADER_SIZE
+    b = core.Node(DIFF, 1)
+    assert b.load(blob)
+    assert b.height == 2 and b.tip_hash == a.tip_hash
+    # Corrupted blob is rejected and leaves the node unchanged.
+    bad = blob[:-1] + bytes([blob[-1] ^ 0xFF])
+    c = core.Node(DIFF, 2)
+    assert not c.load(bad)
+    assert c.height == 0

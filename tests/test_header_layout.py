@@ -1,0 +1,49 @@
+"""Golden-byte tests for the frozen 80-byte header layout (chain.hpp).
+
+Bit-exact serialization is hard part #1 in SURVEY.md §7 — these tests pin
+the byte layout both backends depend on.
+"""
+import hashlib
+import struct
+
+from mpi_blockchain_tpu import core
+
+
+def test_layout_golden_bytes():
+    node = core.Node(difficulty_bits=8, node_id=0)
+    cand = node.make_candidate(b"payload")
+    f = core.HeaderFields.unpack(cand)
+    assert f.version == 1
+    assert f.prev_hash == node.tip_hash
+    assert f.data_hash == hashlib.sha256(
+        hashlib.sha256(b"payload").digest()).digest()
+    assert f.timestamp == 1          # deterministic: == height
+    assert f.bits == 8
+    assert f.nonce == 0
+    assert f.pack() == cand
+    # Field offsets, little-endian scalars.
+    assert cand[0:4] == struct.pack("<I", 1)
+    assert cand[68:72] == struct.pack("<I", 1)
+    assert cand[72:76] == struct.pack("<I", 8)
+    assert cand[76:80] == struct.pack("<I", 0)
+
+
+def test_genesis_deterministic():
+    a = core.Node(16, 0)
+    b = core.Node(16, 1)
+    assert a.block_hash(0) == b.block_hash(0)
+    gf = core.HeaderFields.unpack(a.block_header(0))
+    assert gf.prev_hash == b"\x00" * 32
+    assert gf.data_hash == hashlib.sha256(
+        hashlib.sha256(b"genesis").digest()).digest()
+    assert gf.timestamp == 0 and gf.nonce == 0 and gf.bits == 16
+    # Different difficulty -> different (but still deterministic) genesis.
+    c = core.Node(8, 0)
+    assert c.block_hash(0) != a.block_hash(0)
+
+
+def test_set_nonce():
+    hdr = bytes(range(80))
+    h2 = core.set_nonce(hdr, 0xDEADBEEF)
+    assert h2[:76] == hdr[:76]
+    assert struct.unpack("<I", h2[76:])[0] == 0xDEADBEEF

@@ -1,0 +1,68 @@
+"""C++ hashing layer vs FIPS 180-4 vectors and the hashlib oracle."""
+import hashlib
+import os
+
+from mpi_blockchain_tpu import core
+
+
+def sha256d_ref(b: bytes) -> bytes:
+    return hashlib.sha256(hashlib.sha256(b).digest()).digest()
+
+
+def test_fips_vectors():
+    assert core.sha256(b"abc").hex() == (
+        "ba7816bf8f01cfea414140de5dae2223b00361a396177a9cb410ff61f20015ad")
+    assert core.sha256(b"").hex() == (
+        "e3b0c44298fc1c149afbf4c8996fb92427ae41e4649b934ca495991b7852b855")
+    assert core.sha256(
+        b"abcdbcdecdefdefgefghfghighijhijkijkljklmklmnlmnomnopnopq").hex() == (
+        "248d6a61d20638b8e5c026930c3e6039a33ce45964ff2167f6ecedd419db06c1")
+
+
+def test_against_hashlib_lengths():
+    # Cross the chunk boundaries: 55/56/63/64/65 bytes and the 80-byte header.
+    for n in [0, 1, 31, 32, 55, 56, 63, 64, 65, 79, 80, 81, 127, 128, 1000]:
+        m = os.urandom(n)
+        assert core.sha256(m) == hashlib.sha256(m).digest(), n
+        assert core.sha256d(m) == sha256d_ref(m), n
+
+
+def test_header_hash_and_midstate():
+    hdr = os.urandom(core.HEADER_SIZE)
+    assert core.header_hash(hdr) == sha256d_ref(hdr)
+    midstate, tail = core.header_midstate(hdr)
+    assert midstate.shape == (8,) and tail.shape == (16,)
+    # Chunk-2 template words: pad word, zeros, bit length.
+    assert tail[4] == 0x80000000
+    assert all(tail[i] == 0 for i in range(5, 15))
+    assert tail[15] == 640
+
+
+def test_leading_zero_bits():
+    assert core.leading_zero_bits(b"\x00" * 32) == 256
+    assert core.leading_zero_bits(b"\x80" + b"\x00" * 31) == 0
+    assert core.leading_zero_bits(b"\x01" + b"\xff" * 31) == 7
+    assert core.leading_zero_bits(b"\x00\x00\x10" + b"\x00" * 29) == 19
+
+
+def test_cpu_search_lowest_nonce():
+    hdr = bytes(range(80))
+    nonce, tried = core.cpu_search(hdr, 0, 1 << 20, 10)
+    assert nonce is not None
+    assert tried == nonce + 1  # sequential sweep stops at the first hit
+    digest = core.header_hash(core.set_nonce(hdr, nonce))
+    assert core.leading_zero_bits(digest) >= 10
+    # Minimality: nothing below qualifies.
+    below, _ = core.cpu_search(hdr, 0, nonce, 10)
+    assert below is None
+
+
+def test_cpu_search_range_and_miss():
+    hdr = bytes(range(80))
+    nonce, _ = core.cpu_search(hdr, 0, 1 << 20, 10)
+    # Starting above the winner finds a different (higher) nonce.
+    n2, _ = core.cpu_search(hdr, nonce + 1, 1 << 22, 10)
+    assert n2 is not None and n2 > nonce
+    # Impossible difficulty in a tiny range: miss.
+    miss, tried = core.cpu_search(hdr, 0, 1000, 60)
+    assert miss is None and tried == 1000
