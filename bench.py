@@ -1,0 +1,49 @@
+"""Benchmark harness: prints ONE JSON line with the primary metric.
+
+Metric (BASELINE.json): hashes/sec/chip on the TPU sweep, with vs_baseline =
+TPU total rate / 8-rank CPU total rate (the mpirun -np 8 stand-in: 8 C++
+threads running the scalar miner loop with the GIL released — OpenMPI is not
+in this image; documented in BASELINE.md).
+
+Runs on whatever JAX platform is default (the real TPU chip under the
+driver); falls back to the jnp kernel automatically if Pallas is unavailable.
+"""
+from __future__ import annotations
+
+import json
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent))
+
+
+def main() -> int:
+    from mpi_blockchain_tpu.bench_lib import bench_cpu, bench_tpu
+
+    cpu = bench_cpu(seconds=2.0, n_miners=8)
+    try:
+        tpu = bench_tpu(seconds=5.0, batch_pow2=22, n_miners=1,
+                        kernel="auto")
+        value = tpu["hashes_per_sec_per_chip"]
+        vs = tpu["hashes_per_sec"] / cpu["hashes_per_sec"]
+        detail = {"tpu": {k: round(v, 1) if isinstance(v, float) else v
+                          for k, v in tpu.items()},
+                  "cpu_np8": {k: round(v, 1) if isinstance(v, float) else v
+                              for k, v in cpu.items()}}
+    except Exception as e:  # no usable device: report the CPU number
+        value = cpu["hashes_per_sec_per_rank"]
+        vs = 1.0 / 8.0
+        detail = {"error": f"tpu bench failed: {type(e).__name__}: {e}",
+                  "cpu_np8": cpu}
+    print(json.dumps({
+        "metric": "hashes_per_sec_per_chip",
+        "value": round(value),
+        "unit": "hashes/s/chip",
+        "vs_baseline": round(vs, 3),
+        "detail": detail,
+    }, sort_keys=True))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

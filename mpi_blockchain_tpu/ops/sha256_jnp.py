@@ -77,32 +77,31 @@ def compress(state, w, unroll: int = 8):
     # makes its varying-axes type match the per-round outputs.
     vzero = W16[3] & np.uint32(0)
 
-    def sched_step(window, _):
-        # window: the last 16 schedule words, (16, *B)
-        w15, w2 = window[1], window[14]
-        s0 = _rotr(w15, 7) ^ _rotr(w15, 18) ^ (w15 >> np.uint32(3))
-        s1 = _rotr(w2, 17) ^ _rotr(w2, 19) ^ (w2 >> np.uint32(10))
-        new = window[0] + s0 + window[9] + s1
-        return jnp.concatenate([window[1:], new[None]], axis=0), new
-
-    _, w_rest = jax.lax.scan(sched_step, W16, None, length=48, unroll=unroll)
-    W = jnp.concatenate([W16, w_rest], axis=0)  # (64, *B)
-
-    def round_step(carry, kw):
-        a, b, c, d, e, f, g, h = carry
-        k, wi = kw
+    # One scan fuses the message schedule into the rounds with a rotating
+    # 16-word window (window[k] == w[round+k]), so the live state per nonce
+    # is 24 uint32 words — never a materialized (64, B) schedule, which at
+    # mining batch sizes would cost O(GiB) of HBM.
+    def round_step(carry, k):
+        window, (a, b, c, d, e, f, g, h) = carry
+        wi = window[0]
         S1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
         ch = (e & f) ^ (~e & g)
         t1 = h + S1 + ch + k + wi
         S0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
         maj = (a & b) ^ (a & c) ^ (b & c)
         t2 = S0 + maj
-        return (t1 + t2, a, b, c, d + t1, e, f, g), None
+        # Schedule: w[r+16] = w[r] + s0(w[r+1]) + w[r+9] + s1(w[r+14]).
+        w1, w14 = window[1], window[14]
+        s0 = _rotr(w1, 7) ^ _rotr(w1, 18) ^ (w1 >> np.uint32(3))
+        s1 = _rotr(w14, 17) ^ _rotr(w14, 19) ^ (w14 >> np.uint32(10))
+        nxt = wi + s0 + window[9] + s1
+        window = jnp.concatenate([window[1:], nxt[None]], axis=0)
+        return (window, (t1 + t2, a, b, c, d + t1, e, f, g)), None
 
     st = tuple(jnp.broadcast_to(jnp.asarray(s, _U32), shape) ^ vzero
                for s in state)
-    out, _ = jax.lax.scan(round_step, st, (jnp.asarray(K, _U32), W),
-                          unroll=unroll)
+    (_, out), _ = jax.lax.scan(round_step, (W16, st), jnp.asarray(K, _U32),
+                               unroll=unroll)
     return tuple(o + s for o, s in zip(out, st))
 
 

@@ -1,0 +1,171 @@
+"""Pallas TPU kernel: fused double-SHA-256 nonce sweep.
+
+The hot op of the framework (SURVEY.md §7 step 5). Design, per the TPU
+kernel playbook:
+
+  * Grid over nonce tiles; each program sweeps a (ROWS, 128) uint32 tile of
+    nonces resident in VMEM — 128 lanes to match the VPU, ROWS sublanes to
+    amortize control overhead. No HBM traffic inside the kernel at all: the
+    nonce values are synthesized from program_id with iota, and only the
+    per-tile (count, min_nonce) reduction leaves the chip.
+  * Both compressions are fully unrolled straight-line vector code (Mosaic
+    compiles this quickly, unlike the XLA CPU backend) with the rotating
+    16-word schedule window, so the live set is ~24 (ROWS,128) u32 registers.
+  * The chunk-1 midstate and the constant chunk-2 words arrive via scalar
+    prefetch (SMEM); only the nonce word varies per lane.
+
+Bit-exactness: identical round structure to core/src/sha256.cpp
+(sha256d_from_midstate); verified against the C++ oracle in
+tests/test_pallas.py and, on real TPU, by the backend-equivalence suite.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .sha256_jnp import IV, K, NOT_FOUND_U32
+
+_U32 = jnp.uint32
+_LANES = 128
+_ROWS = 64                      # 64*128 = 8192 nonces per grid program
+TILE = _ROWS * _LANES
+
+
+def _rotr(x, n: int):
+    return (x >> np.uint32(n)) | (x << np.uint32(32 - n))
+
+
+def _bswap32(x):
+    return ((x & np.uint32(0xFF)) << np.uint32(24)) \
+         | ((x & np.uint32(0xFF00)) << np.uint32(8)) \
+         | ((x >> np.uint32(8)) & np.uint32(0xFF00)) \
+         | (x >> np.uint32(24))
+
+
+def _compress_unrolled(state, w):
+    """64 unrolled SHA-256 rounds with a rotating schedule window.
+
+    state: tuple of 8 (ROWS,128) u32; w: list of 16 (ROWS,128) u32.
+    """
+    window = list(w)
+    a, b, c, d, e, f, g, h = state
+    for r in range(64):
+        wi = window[0]
+        S1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+        ch = (e & f) ^ (~e & g)
+        t1 = h + S1 + ch + np.uint32(K[r]) + wi
+        S0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+        maj = (a & b) ^ (a & c) ^ (b & c)
+        t2 = S0 + maj
+        h, g, f, e = g, f, e, d + t1
+        d, c, b, a = c, b, a, t1 + t2
+        # w[r+16] = w[r] + s0(w[r+1]) + w[r+9] + s1(w[r+14])
+        w1, w14 = window[1], window[14]
+        s0 = _rotr(w1, 7) ^ _rotr(w1, 18) ^ (w1 >> np.uint32(3))
+        s1 = _rotr(w14, 17) ^ _rotr(w14, 19) ^ (w14 >> np.uint32(10))
+        window = window[1:] + [wi + s0 + window[9] + s1]
+    out = (a, b, c, d, e, f, g, h)
+    return tuple(o + s for o, s in zip(out, state))
+
+
+def _sweep_kernel(midstate_ref, tail_ref, base_ref, count_ref, min_ref, *,
+                  difficulty_bits: int):
+    pid = pl.program_id(0)
+    base = base_ref[0] + (pid * np.uint32(TILE)).astype(_U32)
+    row = jax.lax.broadcasted_iota(_U32, (_ROWS, _LANES), 0)
+    lane = jax.lax.broadcasted_iota(_U32, (_ROWS, _LANES), 1)
+    nonces = base + row * np.uint32(_LANES) + lane
+
+    full = lambda v: jnp.full((_ROWS, _LANES), v, _U32)
+    # Chunk 2 of the first hash: constant words from SMEM, nonce in word 3.
+    w1 = [full(tail_ref[i]) if i != 3 else _bswap32(nonces)
+          for i in range(16)]
+    st1 = tuple(full(midstate_ref[i]) for i in range(8))
+    d1 = _compress_unrolled(st1, w1)
+    # Second hash: one padded chunk whose first 8 words are digest 1.
+    w2 = list(d1) + [full(np.uint32(0x80000000))] + [full(np.uint32(0))] * 6 \
+        + [full(np.uint32(256))]
+    st2 = tuple(full(np.uint32(v)) for v in IV)
+    d2 = _compress_unrolled(st2, w2)
+
+    # Leading-zero-bits difficulty check on the big-endian digest.
+    h0, h1 = d2[0], d2[1]
+    dbits = int(difficulty_bits)
+    if dbits <= 0:
+        qual = jnp.ones_like(h0, dtype=jnp.bool_)
+    elif dbits < 32:
+        qual = h0 < np.uint32(1 << (32 - dbits))
+    elif dbits == 32:
+        qual = h0 == np.uint32(0)
+    elif dbits < 64:
+        qual = (h0 == np.uint32(0)) & (h1 < np.uint32(1 << (64 - dbits)))
+    else:
+        qual = (h0 == np.uint32(0)) & (h1 == np.uint32(0))
+
+    # The TPU grid runs sequentially on a core, so programs accumulate into
+    # one (1,1) SMEM cell: initialize at program 0, then reduce. Mosaic has
+    # no unsigned reductions, so the min runs on bias-flipped int32
+    # (x ^ 0x80000000 is order-isomorphic uint32 -> int32); the caller
+    # unbiases. The 0xFFFFFFFF sentinel biases to int32 max — the identity.
+    @pl.when(pid == 0)
+    def _():
+        count_ref[0, 0] = jnp.int32(0)
+        min_ref[0, 0] = jnp.int32(0x7FFFFFFF)
+
+    count_ref[0, 0] += jnp.sum(qual.astype(jnp.int32))
+    biased = jax.lax.bitcast_convert_type(
+        jnp.where(qual, nonces, NOT_FOUND_U32) ^ np.uint32(0x80000000),
+        jnp.int32)
+    min_ref[0, 0] = jnp.minimum(min_ref[0, 0], jnp.min(biased))
+
+
+def pallas_sweep_core(midstate, tail_w, base_nonce, *, batch_size: int,
+                      difficulty_bits: int, interpret: bool = False):
+    """Sweeps [base_nonce, base_nonce + batch_size) on one TPU core.
+
+    Same contract as sha256_jnp.sweep_core: returns (count, min_nonce).
+    batch_size must be a multiple of the 8192-nonce tile.
+    """
+    if batch_size % TILE != 0:
+        raise ValueError(f"batch_size {batch_size} not a multiple of {TILE}")
+    n_tiles = batch_size // TILE
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,      # midstate, tail, base — all SMEM scalars
+        grid=(n_tiles,),
+        in_specs=[],
+        out_specs=[
+            pl.BlockSpec((1, 1), lambda i, *_: (0, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1), lambda i, *_: (0, 0),
+                         memory_space=pltpu.SMEM),
+        ],
+    )
+    count, min_biased = pl.pallas_call(
+        functools.partial(_sweep_kernel, difficulty_bits=difficulty_bits),
+        out_shape=[jax.ShapeDtypeStruct((1, 1), jnp.int32),
+                   jax.ShapeDtypeStruct((1, 1), jnp.int32)],
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(jnp.asarray(midstate, _U32), jnp.asarray(tail_w, _U32),
+      jnp.asarray(base_nonce, _U32).reshape((1,)))
+    min_nonce = jax.lax.bitcast_convert_type(
+        min_biased[0, 0], _U32) ^ np.uint32(0x80000000)
+    return count[0, 0], min_nonce
+
+
+def make_pallas_sweep_fn(batch_size: int, difficulty_bits: int,
+                         interpret: bool = False):
+    """jit'd (midstate, tail_w, base_nonce) -> (count, min_nonce)."""
+    @jax.jit
+    def fn(midstate, tail_w, base_nonce):
+        return pallas_sweep_core(midstate, tail_w, base_nonce,
+                                 batch_size=batch_size,
+                                 difficulty_bits=difficulty_bits,
+                                 interpret=interpret)
+    return fn

@@ -1,0 +1,52 @@
+"""Multi-host scaling: the DCN analogue of the reference's multi-node MPI.
+
+The reference scales past one machine by launching more MPI ranks
+(`mpirun -np N` across hosts); this framework scales the same search by
+widening the 'miners' mesh across TPU hosts. The sharded sweep program in
+parallel/mesh.py is written against mesh axis names, not device counts, so
+it runs unchanged on a multi-host mesh: XLA routes the psum/pmin winner
+collectives over ICI within a slice and DCN across slices — no NCCL/MPI
+translation, per the project's TPU-first mandate.
+
+Single-host processes (this image has one host/chip) use init_local; a real
+multi-host job calls init_distributed on every host with the same
+coordinator address before any jax call, then make_global_miner_mesh.
+"""
+from __future__ import annotations
+
+import jax
+
+from .mesh import make_miner_mesh
+
+
+def init_distributed(coordinator_address: str | None = None,
+                     num_processes: int | None = None,
+                     process_id: int | None = None) -> None:
+    """Joins the jax.distributed world (call once per host, before jax use).
+
+    With no arguments, jax.distributed.initialize auto-discovers the TPU pod
+    topology from the environment (the standard v5e multi-host launch).
+    """
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+
+
+def make_global_miner_mesh() -> jax.sharding.Mesh:
+    """1-D ('miners',) mesh over every device in the (multi-host) world.
+
+    jax.devices() is global after init_distributed, so the mesh spans hosts;
+    each host runs the same sharded sweep and XLA keeps the winner-select
+    collective consistent across DCN.
+    """
+    return jax.make_mesh((len(jax.devices()),), ("miners",))
+
+
+def world_info() -> dict:
+    """Process/topology info (the reference's rank/size introspection)."""
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_devices": len(jax.local_devices()),
+        "global_devices": len(jax.devices()),
+    }

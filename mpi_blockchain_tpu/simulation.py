@@ -1,0 +1,204 @@
+"""Multi-node network simulation + adversarial harness (BASELINE config 5).
+
+Rebuilds the reference's multi-rank world — N nodes that mine concurrently,
+announce found blocks, and resolve forks by longest-chain — as an in-process
+simulation: C++ Nodes connected by a message bus with injectable delay,
+drop, and partition faults (SURVEY.md §5 "failure detection": harness-level
+fault injection on block announcements).
+
+Determinism: the simulation advances in discrete steps. Each step, every
+live group mines with a bounded nonce budget; found blocks are enqueued on
+the bus with a configurable delivery delay (in steps). Within a step,
+deliveries happen before mining, in (send_step, sender_id) order. Given the
+same faults schedule, a run is exactly reproducible — the adversarial reorg
+tests assert on this.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+from . import core
+from .backend import MinerBackend, get_backend
+from .config import MinerConfig
+
+
+@dataclasses.dataclass
+class _Message:
+    send_step: int
+    deliver_step: int
+    sender: int
+    header80: bytes
+
+
+@dataclasses.dataclass
+class GroupStats:
+    blocks_mined: int = 0
+    blocks_accepted_from_peers: int = 0
+    reorgs: int = 0
+    reorged_away_blocks: int = 0   # own blocks lost to adoption of a longer chain
+
+
+class SimNode:
+    """One miner group in the simulation: a C++ Node + backend + progress."""
+
+    def __init__(self, node_id: int, config: MinerConfig,
+                 backend: MinerBackend | None = None):
+        self.id = node_id
+        self.config = config
+        self.node = core.Node(config.difficulty_bits, node_id)
+        self.backend = backend if backend is not None else get_backend(
+            "cpu", batch_size=config.batch_size)
+        self.stats = GroupStats()
+        # Per-height search position, so a group resumes its sweep across
+        # steps instead of restarting at nonce 0 (restarting would let a
+        # slower group never finish a block at higher difficulty).
+        self._next_nonce = 0
+        self._tip_at_start = self.node.tip_hash
+
+    def _candidate(self) -> bytes:
+        data = f"{self.config.data_prefix}:g{self.id}:" \
+               f"{self.node.height + 1}".encode()
+        return self.node.make_candidate(data)
+
+    def mine_step(self, nonce_budget: int) -> bytes | None:
+        """Searches up to nonce_budget nonces; returns a mined header or None.
+
+        The tip moving (own block or peer block adopted) resets the sweep —
+        the reference's preemption point (SURVEY.md §3.2): a stale candidate
+        would fail prev-hash validation anyway.
+        """
+        tip = self.node.tip_hash
+        if tip != self._tip_at_start:
+            self._next_nonce = 0
+            self._tip_at_start = tip
+        cand = self._candidate()
+        res = self.backend.search(cand, self.config.difficulty_bits,
+                                  start_nonce=self._next_nonce,
+                                  max_count=nonce_budget)
+        if res.nonce is None:
+            self._next_nonce += nonce_budget
+            if self._next_nonce >= 1 << 32:
+                self._next_nonce = 0  # exhausted: wrap (different data next block)
+            return None
+        winner = core.set_nonce(cand, res.nonce)
+        assert self.node.submit(winner), "own block failed validation"
+        self.stats.blocks_mined += 1
+        self._next_nonce = 0
+        self._tip_at_start = self.node.tip_hash
+        return winner
+
+    def receive(self, header80: bytes, fetch_chain: Callable[[], list[bytes]]
+                ) -> None:
+        """Consensus on a peer announcement (SURVEY.md §3.3)."""
+        r = self.node.receive(header80)
+        if r == core.RecvResult.APPENDED:
+            self.stats.blocks_accepted_from_peers += 1
+        elif r == core.RecvResult.STALE_OR_FORK:
+            own_height = self.node.height
+            if self.node.adopt_chain(fetch_chain()) == core.RecvResult.REORGED:
+                self.stats.reorgs += 1
+                self.stats.reorged_away_blocks += own_height
+
+
+class Network:
+    """Message bus with fault injection between SimNodes."""
+
+    def __init__(self, nodes: list[SimNode], delay_steps: int = 0,
+                 drop_fn: Callable[[int, int, int], bool] | None = None,
+                 partitioned_until: int | None = None):
+        """drop_fn(step, sender, receiver) -> True to drop the delivery.
+
+        partitioned_until: until that step, announcements do not cross
+        between nodes at all (two isolated miner groups building competing
+        chains — the BASELINE config-5 adversary).
+        """
+        self.nodes = nodes
+        self.delay_steps = delay_steps
+        self.drop_fn = drop_fn
+        self.partitioned_until = partitioned_until
+        self.queue: list[_Message] = []
+        self.step_count = 0
+
+    def _blocked(self, step: int, sender: int, receiver: int) -> bool:
+        if self.partitioned_until is not None and step < self.partitioned_until:
+            return True
+        if self.drop_fn is not None and self.drop_fn(step, sender, receiver):
+            return True
+        return False
+
+    def broadcast(self, sender: int, header80: bytes) -> None:
+        self.queue.append(_Message(self.step_count,
+                                   self.step_count + self.delay_steps,
+                                   sender, header80))
+
+    def deliver_due(self) -> None:
+        due = [m for m in self.queue if m.deliver_step <= self.step_count]
+        self.queue = [m for m in self.queue if m.deliver_step > self.step_count]
+        due.sort(key=lambda m: (m.send_step, m.sender))
+        for m in due:
+            sender_node = self.nodes[m.sender]
+            for node in self.nodes:
+                if node.id == m.sender:
+                    continue
+                if self._blocked(self.step_count, m.sender, node.id):
+                    # Re-queue across a partition: real networks retransmit;
+                    # the reference's collective world never loses the
+                    # broadcast, so the partition delays rather than
+                    # destroys it.
+                    if (self.partitioned_until is not None
+                            and self.step_count < self.partitioned_until):
+                        self.queue.append(dataclasses.replace(
+                            m, deliver_step=self.partitioned_until))
+                    continue
+                node.receive(m.header80, sender_node.node.all_headers)
+
+    def step(self, nonce_budget: int = 1 << 16) -> None:
+        """One simulation step: deliver, then every group mines a slice."""
+        self.deliver_due()
+        for node in self.nodes:
+            mined = node.mine_step(nonce_budget)
+            if mined is not None:
+                self.broadcast(node.id, mined)
+        self.step_count += 1
+
+    def run(self, target_height: int, max_steps: int = 10_000,
+            nonce_budget: int = 1 << 16) -> int:
+        """Steps until every node reaches target_height on ONE chain.
+
+        Mining continues past target_height while tips disagree: an
+        equal-height fork (both groups found a block at the same height) can
+        only be broken by the next block — the keep-first rule means neither
+        side adopts at equal length, exactly like the reference's
+        longest-chain world.
+        """
+        while self.step_count < max_steps:
+            self.step(nonce_budget)
+            if all(n.node.height >= target_height for n in self.nodes):
+                # Flush in-flight announcements, then check for one chain.
+                for _ in range(self.delay_steps + 1):
+                    self.deliver_due()
+                if self.converged():
+                    return self.step_count
+        raise RuntimeError(f"no convergence in {max_steps} steps")
+
+    def converged(self) -> bool:
+        tips = {n.node.tip_hash for n in self.nodes}
+        return len(tips) == 1
+
+
+def run_adversarial(config: MinerConfig | None = None,
+                    partition_steps: int = 30, target_height: int = 8,
+                    nonce_budget: int = 1 << 8) -> Network:
+    """BASELINE config 5: two competing miner groups, then reconciliation.
+
+    Two groups mine in a partition (building competing chains with different
+    payloads), the partition heals, and longest-chain reorg resolution must
+    converge every node onto one chain.
+    """
+    cfg = config if config is not None else MinerConfig(
+        difficulty_bits=8, n_blocks=target_height, backend="cpu")
+    nodes = [SimNode(0, cfg), SimNode(1, cfg)]
+    net = Network(nodes, delay_steps=1, partitioned_until=partition_steps)
+    net.run(target_height, nonce_budget=nonce_budget)
+    return net

@@ -1,0 +1,46 @@
+"""Chain checkpoint/resume (SURVEY.md §5).
+
+The reference has no persistence; the rebuild adds it so the 1000-block
+bench is restartable. A checkpoint is the chain's canonical wire format
+(concatenated 80-byte headers — the same bytes Chain::save emits and the
+adopt_chain RPC uses) plus a JSON sidecar with the config, so resume can
+refuse a difficulty mismatch instead of silently mining an invalid suffix.
+There is no device state to checkpoint: the search is stateless per block.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import pathlib
+
+from .. import core
+from ..config import MinerConfig
+
+
+def save_chain(node: core.Node, path: str | pathlib.Path,
+               config: MinerConfig | None = None) -> None:
+    path = pathlib.Path(path)
+    path.write_bytes(node.save())
+    meta = {"height": node.height, "tip_hash": node.tip_hash.hex(),
+            "difficulty_bits": node.difficulty_bits}
+    if config is not None:
+        meta["config"] = dataclasses.asdict(config)
+    path.with_suffix(path.suffix + ".json").write_text(
+        json.dumps(meta, sort_keys=True))
+
+
+def load_chain(path: str | pathlib.Path, difficulty_bits: int,
+               node_id: int = 0) -> core.Node:
+    """Restores a Node from a checkpoint, re-validating every block."""
+    path = pathlib.Path(path)
+    sidecar = path.with_suffix(path.suffix + ".json")
+    if sidecar.exists():
+        meta = json.loads(sidecar.read_text())
+        if meta.get("difficulty_bits") != difficulty_bits:
+            raise ValueError(
+                f"checkpoint difficulty {meta.get('difficulty_bits')} != "
+                f"requested {difficulty_bits}")
+    node = core.Node(difficulty_bits, node_id)
+    if not node.load(path.read_bytes()):
+        raise ValueError(f"invalid or corrupt chain checkpoint: {path}")
+    return node

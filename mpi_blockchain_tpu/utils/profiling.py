@@ -1,0 +1,24 @@
+"""Profiling hooks (SURVEY.md §5 tracing).
+
+Wraps jax.profiler so a mining run can capture a perfetto-compatible device
+trace of the sweep kernels:
+
+    with trace_mining("/tmp/trace"):
+        miner.mine_chain(10)
+
+View with ui.perfetto.dev or tensorboard --logdir.
+"""
+from __future__ import annotations
+
+import contextlib
+
+
+@contextlib.contextmanager
+def trace_mining(logdir: str):
+    import jax
+
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -1,0 +1,39 @@
+"""Pallas kernel vs the C++ oracle.
+
+The suite platform is CPU (conftest), where Mosaic cannot run, so these
+tests only execute on a real TPU (e.g. `pytest tests/test_pallas.py` with
+the axon platform and no conftest forcing — see .claude/skills/verify).
+The cross-kernel equivalence also runs implicitly in bench.py and in the
+tpu backend's auto selection on hardware.
+"""
+import jax
+import numpy as np
+import pytest
+
+from mpi_blockchain_tpu import core
+
+if jax.default_backend() != "tpu":
+    pytest.skip("pallas sweep requires a real TPU (suite runs on CPU)",
+                allow_module_level=True)
+
+from mpi_blockchain_tpu.ops.sha256_pallas import (TILE,             # noqa: E402
+                                                  make_pallas_sweep_fn)
+
+
+def test_pallas_matches_oracle():
+    hdr = bytes(range(80))
+    midstate, tail = core.header_midstate(hdr)
+    fn = make_pallas_sweep_fn(TILE * 2, 8)
+    count, mn = fn(midstate, tail, np.uint32(0))
+    oracle, _ = core.cpu_search(hdr, 0, TILE * 2, 8)
+    assert int(mn) == oracle
+    # Exhaustive count agreement.
+    qual = sum(core.leading_zero_bits(
+        core.header_hash(core.set_nonce(hdr, n))) >= 8
+        for n in range(TILE * 2))
+    assert int(count) == qual
+
+
+def test_pallas_batch_validation():
+    with pytest.raises(ValueError):
+        make_pallas_sweep_fn(TILE + 1, 8)

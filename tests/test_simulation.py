@@ -1,0 +1,66 @@
+"""Multi-node simulation: propagation, delay, partition + reorg (config 5)."""
+import pytest
+
+from mpi_blockchain_tpu.config import MinerConfig
+from mpi_blockchain_tpu.simulation import Network, SimNode, run_adversarial
+
+CFG = MinerConfig(difficulty_bits=8, n_blocks=6, backend="cpu")
+
+
+def make_net(n_nodes=2, **kwargs) -> Network:
+    return Network([SimNode(i, CFG) for i in range(n_nodes)], **kwargs)
+
+
+def test_two_nodes_converge_no_faults():
+    net = make_net(2)
+    net.run(target_height=6, nonce_budget=1 << 8)
+    assert net.converged()
+    a, b = net.nodes
+    # Blocks flowed both ways or one node dominated; either way heights agree.
+    assert a.node.height == b.node.height >= 6
+
+
+def test_four_nodes_with_delay_converge():
+    net = make_net(4, delay_steps=2)
+    net.run(target_height=5, nonce_budget=1 << 8)
+    assert net.converged()
+
+
+def test_partition_creates_fork_then_reorg_resolves():
+    net = run_adversarial(partition_steps=25, target_height=6)
+    a, b = net.nodes
+    assert net.converged(), (
+        f"tips diverge: {a.node.tip_hash.hex()[:12]} vs "
+        f"{b.node.tip_hash.hex()[:12]}")
+    # Both groups really mined during the partition (competing chains)…
+    assert a.stats.blocks_mined > 0 and b.stats.blocks_mined > 0
+    # …so at least one side must have reorged when the partition healed
+    # (equal-length ties keep-first, so allow the rare no-reorg tie only if
+    # tips already agree — converged() above would still hold).
+    assert a.stats.reorgs + b.stats.reorgs >= 1
+
+
+def test_adversarial_deterministic():
+    n1 = run_adversarial(partition_steps=20, target_height=5)
+    n2 = run_adversarial(partition_steps=20, target_height=5)
+    assert [n.node.tip_hash for n in n1.nodes] == \
+           [n.node.tip_hash for n in n2.nodes]
+    assert n1.step_count == n2.step_count
+
+
+def test_drop_fault_delays_but_converges():
+    # Drop every announcement to node 1 for the first 10 steps.
+    net = make_net(2, drop_fn=lambda step, s, r: r == 1 and step < 10)
+    net.run(target_height=5, nonce_budget=1 << 8)
+    # Node 1 missed early blocks; longest-chain fetch-and-adopt must have
+    # caught it up regardless.
+    assert net.converged()
+
+
+def test_chain_validity_after_convergence():
+    from mpi_blockchain_tpu import core
+    net = run_adversarial(partition_steps=15, target_height=5)
+    blob = net.nodes[0].node.save()
+    check = core.Node(CFG.difficulty_bits, 99)
+    assert check.load(blob)
+    assert check.tip_hash == net.nodes[1].node.tip_hash

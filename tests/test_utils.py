@@ -1,0 +1,47 @@
+"""Checkpoint/resume + distributed-module shape tests."""
+import pytest
+
+from mpi_blockchain_tpu import core
+from mpi_blockchain_tpu.config import MinerConfig
+from mpi_blockchain_tpu.models.miner import Miner
+from mpi_blockchain_tpu.utils.checkpoint import load_chain, save_chain
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    cfg = MinerConfig(difficulty_bits=8, n_blocks=3, backend="cpu")
+    miner = Miner(cfg)
+    miner.mine_chain()
+    path = tmp_path / "chain.bin"
+    save_chain(miner.node, path, cfg)
+    resumed = load_chain(path, 8)
+    assert resumed.height == 3
+    assert resumed.tip_hash == miner.node.tip_hash
+    # Resume mining on top of the checkpoint.
+    m2 = Miner(cfg)
+    m2.node = resumed
+    m2.mine_block()
+    assert m2.node.height == 4
+
+
+def test_checkpoint_difficulty_mismatch(tmp_path):
+    cfg = MinerConfig(difficulty_bits=8, n_blocks=1, backend="cpu")
+    miner = Miner(cfg)
+    miner.mine_chain()
+    path = tmp_path / "chain.bin"
+    save_chain(miner.node, path, cfg)
+    with pytest.raises(ValueError, match="difficulty"):
+        load_chain(path, 16)
+
+
+def test_checkpoint_corrupt(tmp_path):
+    path = tmp_path / "chain.bin"
+    path.write_bytes(b"\x00" * 160)
+    with pytest.raises(ValueError, match="invalid"):
+        load_chain(path, 8)
+
+
+def test_world_info_single_process():
+    from mpi_blockchain_tpu.parallel.distributed import world_info
+    info = world_info()
+    assert info["process_count"] == 1
+    assert info["global_devices"] == 8  # virtual CPU mesh from conftest
