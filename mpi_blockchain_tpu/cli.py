@@ -49,7 +49,11 @@ def cmd_mine(args) -> int:
     cfg = _config_from(args)
     if args.verbose:
         get_logger().setLevel("DEBUG")
-    miner = Miner(cfg)
+    if args.fused:
+        from .models.fused import FusedMiner
+        miner = FusedMiner(cfg, blocks_per_call=args.blocks_per_call)
+    else:
+        miner = Miner(cfg)
     t0 = time.perf_counter()
     miner.mine_chain()
     wall = time.perf_counter() - t0
@@ -62,10 +66,12 @@ def cmd_mine(args) -> int:
         "height": miner.node.height,
         "tip_hash": miner.node.tip_hash.hex(),
         "wall_s": round(wall, 3),
-        "hashes_tried": miner.total_hashes(),
-        "hashes_per_sec": round(miner.hashes_per_sec()),
-        "backend": miner.backend.name,
+        "fused": args.fused,
     }
+    if not args.fused:
+        summary.update(hashes_tried=miner.total_hashes(),
+                       hashes_per_sec=round(miner.hashes_per_sec()),
+                       backend=miner.backend.name)
     print(json.dumps(summary, sort_keys=True))
     return 0
 
@@ -110,6 +116,10 @@ def main(argv: list[str] | None = None) -> int:
     p_mine.add_argument("--out", help="write the chain to this file")
     p_mine.add_argument("--verbose", action="store_true",
                         help="per-block JSON lines")
+    p_mine.add_argument("--fused", action="store_true",
+                        help="device-resident multi-block mine loop "
+                             "(one device call per --blocks-per-call)")
+    p_mine.add_argument("--blocks-per-call", type=int, default=16)
     p_mine.set_defaults(fn=cmd_mine)
 
     p_verify = sub.add_parser("verify", help="validate a saved chain file")

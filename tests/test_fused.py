@@ -1,0 +1,57 @@
+"""Fused device-resident miner vs the per-round path and the CPU oracle."""
+import numpy as np
+import pytest
+
+from mpi_blockchain_tpu.config import MinerConfig
+from mpi_blockchain_tpu.models.fused import FusedMiner, make_fused_miner, \
+    _words_be
+from mpi_blockchain_tpu.models.miner import Miner
+from mpi_blockchain_tpu import core
+
+DIFF = 10
+
+
+@pytest.fixture(scope="module")
+def oracle_chain():
+    m = Miner(MinerConfig(difficulty_bits=DIFF, n_blocks=6, backend="cpu"))
+    m.mine_chain()
+    return m
+
+
+@pytest.mark.parametrize("n_miners,batch_pow2", [(1, 12), (8, 9)])
+def test_fused_identical_chain(oracle_chain, n_miners, batch_pow2):
+    cfg = MinerConfig(difficulty_bits=DIFF, n_blocks=6,
+                      batch_pow2=batch_pow2, n_miners=n_miners,
+                      backend="tpu", kernel="jnp")
+    fm = FusedMiner(cfg, blocks_per_call=4)  # crosses a call boundary
+    fm.mine_chain()
+    assert fm.chain_hashes() == oracle_chain.chain_hashes()
+
+
+def test_fused_multiple_calls_resume(oracle_chain):
+    """Chain continues correctly across separate mine_chain calls."""
+    cfg = MinerConfig(difficulty_bits=DIFF, n_blocks=6, batch_pow2=12,
+                      backend="tpu", kernel="jnp")
+    fm = FusedMiner(cfg, blocks_per_call=2)
+    fm.mine_chain(3)
+    fm.mine_chain(3)
+    assert fm.chain_hashes() == oracle_chain.chain_hashes()
+
+
+def test_fused_fn_outputs_match_host_hash():
+    """The device-computed tip digest equals the C++ header hash."""
+    cfg = MinerConfig(difficulty_bits=8, n_blocks=1, batch_pow2=12,
+                      backend="tpu", kernel="jnp")
+    fm = FusedMiner(cfg, blocks_per_call=1)
+    node = fm.node
+    payload = cfg.payload(1)
+    fn = fm._fn(1)
+    import jax.numpy as jnp
+    nonces, tip = fn(jnp.asarray(_words_be(node.tip_hash)),
+                     jnp.asarray(np.stack([_words_be(core.sha256d(payload))])),
+                     np.uint32(0))
+    cand = node.make_candidate(payload)
+    winner = core.set_nonce(cand, int(np.asarray(nonces)[0]))
+    expect = core.header_hash(winner)
+    got = b"".join(int(w).to_bytes(4, "big") for w in np.asarray(tip))
+    assert got == expect
