@@ -22,7 +22,7 @@ def main() -> int:
 
     cpu = bench_cpu(seconds=2.0, n_miners=8)
     try:
-        tpu = bench_tpu(seconds=5.0, batch_pow2=22, n_miners=1,
+        tpu = bench_tpu(seconds=8.0, batch_pow2=28, n_miners=1,
                         kernel="auto")
         value = tpu["hashes_per_sec_per_chip"]
         vs = tpu["hashes_per_sec"] / cpu["hashes_per_sec"]

@@ -51,6 +51,9 @@ def cmd_mine(args) -> int:
         get_logger().setLevel("DEBUG")
     if args.fused:
         from .models.fused import FusedMiner
+        if args.blocks_per_call < 1:
+            raise ValueError(
+                f"--blocks-per-call must be >= 1, got {args.blocks_per_call}")
         miner = FusedMiner(cfg, blocks_per_call=args.blocks_per_call)
     else:
         miner = Miner(cfg)

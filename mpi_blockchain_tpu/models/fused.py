@@ -33,7 +33,8 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from .. import core
-from ..ops.sha256_jnp import IV, NOT_FOUND_U32, _bswap32, compress
+from ..ops.sha256_jnp import (IV, NOT_FOUND_U32, _bswap32, compress,
+                              sha256d_words_from_midstate)
 
 _U32 = jnp.uint32
 _VERSION_WORD = np.uint32(0x01000000)  # bswap32 of version=1 (LE bytes)
@@ -42,15 +43,6 @@ _VERSION_WORD = np.uint32(0x01000000)  # bswap32 of version=1 (LE bytes)
 def _words_be(digest32: bytes) -> np.ndarray:
     """Digest bytes -> the 8 big-endian uint32 words (SHA state words)."""
     return np.frombuffer(digest32, ">u4").astype(np.uint32)
-
-
-def _sha256d_words(midstate, tail_words):
-    """Double-SHA256 digest words for ONE message given midstate+chunk2."""
-    d1 = compress(tuple(midstate[i] for i in range(8)),
-                  [tail_words[i] for i in range(16)])
-    w2 = list(d1) + [np.uint32(0x80000000)] + [np.uint32(0)] * 6 \
-        + [np.uint32(256)]
-    return compress(tuple(IV), w2)
 
 
 def make_fused_miner(k_blocks: int, batch_pow2: int, difficulty_bits: int,
@@ -108,8 +100,8 @@ def make_fused_miner(k_blocks: int, batch_pow2: int, difficulty_bits: int,
             cond, body, (np.uint32(0), jnp.zeros((), jnp.int32),
                          jnp.asarray(NOT_FOUND_U32)))
         # Digest of the winning header = next prev_hash words.
-        tail_won = tail.at[3].set(_bswap32(nonce))
-        digest = jnp.stack(_sha256d_words(midstate, tail_won))
+        digest = jnp.stack(sha256d_words_from_midstate(
+            midstate, tail, _bswap32(nonce)))
         return nonce, digest
 
     def mine_k(prev_words, data_words, start_height, axis_name=None):
@@ -145,7 +137,6 @@ class FusedMiner:
 
     def __init__(self, config, node_id: int = 0, blocks_per_call: int = 16,
                  mesh=None):
-        from ..config import MinerConfig  # noqa: F401 (typing by duck)
         self.config = config
         self.node = core.Node(config.difficulty_bits, node_id)
         self.blocks_per_call = blocks_per_call
