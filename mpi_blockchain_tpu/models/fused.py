@@ -137,6 +137,9 @@ class FusedMiner:
 
     def __init__(self, config, node_id: int = 0, blocks_per_call: int = 16,
                  mesh=None):
+        if blocks_per_call < 1:
+            raise ValueError(
+                f"blocks_per_call must be >= 1, got {blocks_per_call}")
         self.config = config
         self.node = core.Node(config.difficulty_bits, node_id)
         self.blocks_per_call = blocks_per_call
