@@ -97,6 +97,52 @@ def cmd_verify(args) -> int:
     return 0 if ok else 1
 
 
+def cmd_sim(args) -> int:
+    """BASELINE config 5 from the command line: adversarial partition+reorg."""
+    from .simulation import run_adversarial
+
+    if args.preset:
+        cfg = PRESETS[args.preset]
+    else:  # flags always take effect (difficulty defaults to the sim's 8)
+        cfg = MinerConfig(
+            difficulty_bits=8 if args.difficulty is None else args.difficulty,
+            n_blocks=args.blocks, backend=args.backend,
+            kernel=args.kernel, batch_pow2=args.batch_pow2)
+    try:
+        net = run_adversarial(config=cfg,
+                              partition_steps=args.partition_steps,
+                              target_height=args.blocks,
+                              nonce_budget=1 << args.nonce_budget_pow2)
+    except RuntimeError as e:  # Network.run: no convergence in max_steps
+        print(json.dumps({"event": "sim_done", "converged": False,
+                          "error": str(e)}, sort_keys=True))
+        return 1
+    tips = {n.node.tip_hash.hex() for n in net.nodes}
+    out = {
+        "event": "sim_done",
+        "converged": net.converged(),
+        "steps": net.step_count,
+        "heights": [n.node.height for n in net.nodes],
+        "tips": sorted(tips),
+        "stats": [dataclasses.asdict(n.stats) for n in net.nodes],
+    }
+    print(json.dumps(out, sort_keys=True))
+    return 0 if net.converged() else 1
+
+
+def cmd_info(args) -> int:
+    """Topology/world introspection (the reference's rank/size reporting)."""
+    import jax
+
+    from .parallel.distributed import world_info
+
+    info = world_info()
+    info["platform"] = jax.default_backend()
+    info["devices"] = [str(d) for d in jax.devices()]
+    print(json.dumps(info, sort_keys=True))
+    return 0
+
+
 def cmd_bench(args) -> int:
     from .bench_lib import run_bench
 
@@ -138,6 +184,28 @@ def main(argv: list[str] | None = None) -> int:
     p_bench.add_argument("--kernel", choices=["auto", "jnp", "pallas"],
                          default="auto")
     p_bench.set_defaults(fn=cmd_bench)
+
+    p_sim = sub.add_parser(
+        "sim", help="adversarial 2-group partition + longest-chain reorg "
+                    "simulation (BASELINE config 5)")
+    p_sim.add_argument("--preset", choices=sorted(PRESETS))
+    p_sim.add_argument("--difficulty", type=int, default=None,
+                       help="leading-zero bits (default: sim-internal 8)")
+    p_sim.add_argument("--blocks", type=int, default=8,
+                       help="target height every node must converge to")
+    p_sim.add_argument("--backend", choices=["cpu", "tpu"], default="cpu")
+    p_sim.add_argument("--kernel", choices=["auto", "jnp", "pallas"],
+                       default="auto")
+    p_sim.add_argument("--batch-pow2", type=int, default=12)
+    p_sim.add_argument("--partition-steps", type=int, default=30,
+                       help="steps the 2 groups stay partitioned")
+    p_sim.add_argument("--nonce-budget-pow2", type=int, default=8,
+                       help="log2 nonces each group tries per sim step")
+    p_sim.set_defaults(fn=cmd_sim)
+
+    p_info = sub.add_parser("info", help="world/topology introspection "
+                                         "(rank, size, devices)")
+    p_info.set_defaults(fn=cmd_info)
 
     args = parser.parse_args(argv)
     return args.fn(args)

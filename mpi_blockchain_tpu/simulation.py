@@ -47,8 +47,15 @@ class SimNode:
         self.id = node_id
         self.config = config
         self.node = core.Node(config.difficulty_bits, node_id)
-        self.backend = backend if backend is not None else get_backend(
-            "cpu", batch_size=config.batch_size)
+        if backend is None:  # honor the config's plugin choice (cli `sim
+            # --backend tpu` runs the device sweep inside each group)
+            if config.backend == "cpu":
+                backend = get_backend("cpu", batch_size=config.batch_size)
+            else:
+                backend = get_backend("tpu", batch_pow2=config.batch_pow2,
+                                      n_miners=config.n_miners,
+                                      kernel=config.kernel)
+        self.backend = backend
         self.stats = GroupStats()
         # Per-height search position, so a group resumes its sweep across
         # steps instead of restarting at nonce 0 (restarting would let a

@@ -1,0 +1,94 @@
+"""CLI surface + the 5 BASELINE configs as pytest scenarios (SURVEY.md §4.4).
+
+Each preset runs at reduced difficulty/blocks (full difficulty belongs to
+the bench harness, not CI) with its parallelism shape intact: 1 and 4 CPU
+ranks, single-device TPU, the 8-device mesh, and the adversarial 2-group
+simulation. Every mined chain must be byte-identical to the single-rank CPU
+oracle chain for the same config — the determinism contract.
+"""
+import dataclasses
+import json
+
+import pytest
+
+from mpi_blockchain_tpu.cli import main
+from mpi_blockchain_tpu.config import PRESETS, MinerConfig
+from mpi_blockchain_tpu.models.miner import Miner
+
+DIFF, BLOCKS = 10, 3
+
+
+def _scaled(name: str) -> MinerConfig:
+    cfg = dataclasses.replace(PRESETS[name], difficulty_bits=DIFF,
+                              n_blocks=BLOCKS, batch_pow2=11)
+    if cfg.kernel == "pallas":  # Pallas needs real TPU; CI runs the CPU mesh
+        cfg = dataclasses.replace(cfg, kernel="jnp")
+    return cfg
+
+
+def _oracle_hashes() -> list[str]:
+    miner = Miner(MinerConfig(difficulty_bits=DIFF, n_blocks=BLOCKS,
+                              backend="cpu"))
+    miner.mine_chain()
+    return miner.chain_hashes()
+
+
+@pytest.mark.parametrize("preset", ["cpu-single", "cpu-np4", "tpu-single",
+                                    "tpu-mesh8"])
+def test_preset_scenarios_identical_chain(preset):
+    miner = Miner(_scaled(preset))
+    miner.mine_chain()
+    assert miner.node.height == BLOCKS
+    assert miner.chain_hashes() == _oracle_hashes()
+
+
+def test_preset_adversarial_converges():
+    from mpi_blockchain_tpu.simulation import run_adversarial
+
+    cfg = dataclasses.replace(_scaled("adversarial"), backend="cpu",
+                              difficulty_bits=8)
+    net = run_adversarial(config=cfg, partition_steps=10, target_height=4,
+                          nonce_budget=1 << 8)
+    assert net.converged()
+    tips = {n.node.tip_hash.hex() for n in net.nodes}
+    assert len(tips) == 1
+
+
+def test_cli_sim_subcommand(capsys):
+    rc = main(["sim", "--blocks", "4", "--partition-steps", "10"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert out["converged"] is True
+    assert len(set(out["tips"])) == 1
+    assert all(h >= 4 for h in out["heights"])
+
+
+def test_cli_info_subcommand(capsys):
+    rc = main(["info"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert out["global_devices"] == 8  # the faked CPU mesh
+    assert out["process_count"] == 1
+
+
+def test_cli_mine_preset_flag(tmp_path, capsys):
+    # --preset wires the named config through (difficulty too slow for CI,
+    # so drive the smallest preset shape by flags and check the plumbing by
+    # parsing only).
+    out_file = tmp_path / "c.bin"
+    rc = main(["mine", "--difficulty", "8", "--blocks", "2", "--backend",
+               "cpu", "--out", str(out_file)])
+    summary = json.loads(capsys.readouterr().out)
+    assert rc == 0 and summary["height"] == 2
+    rc = main(["verify", "--chain", str(out_file), "--difficulty", "8"])
+    assert rc == 0
+    assert json.loads(capsys.readouterr().out)["valid"] is True
+
+
+def test_config_from_preset():
+    import argparse
+
+    from mpi_blockchain_tpu.cli import _config_from
+
+    ns = argparse.Namespace(preset="tpu-mesh8")
+    assert _config_from(ns) == PRESETS["tpu-mesh8"]
