@@ -116,6 +116,9 @@ def bench_chain(n_blocks: int = 1000, difficulty_bits: int = 24,
     cfg = MinerConfig(difficulty_bits=difficulty_bits, n_blocks=n_blocks,
                       batch_pow2=batch_pow2, backend="tpu")
     miner = FusedMiner(cfg, blocks_per_call=blocks_per_call)
+    miner.warmup()
+    if n_blocks % blocks_per_call:    # the remainder chunk is its own program
+        miner.warmup(n_blocks % blocks_per_call)
     t0 = _time.perf_counter()
     miner.mine_chain()
     wall = _time.perf_counter() - t0

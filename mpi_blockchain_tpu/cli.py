@@ -103,15 +103,17 @@ def cmd_sim(args) -> int:
 
     if args.preset:
         cfg = PRESETS[args.preset]
+        target_height = cfg.n_blocks
     else:  # flags always take effect (difficulty defaults to the sim's 8)
         cfg = MinerConfig(
             difficulty_bits=8 if args.difficulty is None else args.difficulty,
             n_blocks=args.blocks, backend=args.backend,
             kernel=args.kernel, batch_pow2=args.batch_pow2)
+        target_height = args.blocks
     try:
         net = run_adversarial(config=cfg,
                               partition_steps=args.partition_steps,
-                              target_height=args.blocks,
+                              target_height=target_height,
                               nonce_budget=1 << args.nonce_budget_pow2)
     except RuntimeError as e:  # Network.run: no convergence in max_steps
         print(json.dumps({"event": "sim_done", "converged": False,

@@ -16,7 +16,7 @@ from .sha256_jnp import make_sweep_fn, sweep_core, sweep_jnp  # noqa: F401
 
 
 def select_kernel(kernel: str, batch_size: int, difficulty_bits: int,
-                  shard: bool = False):
+                  shard: bool = False, early_exit: bool = False):
     """Resolves the sweep kernel policy in ONE place (backends + mesh).
 
     kernel: {"auto", "jnp", "pallas"}; auto => pallas on a real TPU, jnp
@@ -25,6 +25,10 @@ def select_kernel(kernel: str, batch_size: int, difficulty_bits: int,
     core (midstate, tail_w, base) -> (count, min_nonce) for use inside
     shard_map. Falls back from pallas to jnp with a visible warning (never
     silently, so bench labels stay honest).
+
+    early_exit=True (pallas only — the jnp kernel ignores it and sweeps the
+    full batch) skips tiles past the first qualifying one: min_nonce stays
+    exact, count degrades to a found-flag. For mine loops, not benches.
     """
     import jax
 
@@ -32,13 +36,20 @@ def select_kernel(kernel: str, batch_size: int, difficulty_bits: int,
         kernel = "pallas" if jax.default_backend() == "tpu" else "jnp"
     if kernel == "pallas":
         try:
-            from .sha256_pallas import (make_pallas_sweep_fn,
+            from .sha256_pallas import (TILE, make_pallas_sweep_fn,
                                         pallas_sweep_core)
+            # Eager, so sub-tile batches fall back here (with the warning)
+            # instead of raising mid-trace inside a caller's mine loop.
+            if batch_size % TILE != 0:
+                raise ValueError(
+                    f"batch_size {batch_size} not a multiple of {TILE}")
             if shard:
                 return functools.partial(
                     pallas_sweep_core, batch_size=batch_size,
-                    difficulty_bits=difficulty_bits), "pallas"
-            return make_pallas_sweep_fn(batch_size, difficulty_bits), "pallas"
+                    difficulty_bits=difficulty_bits,
+                    early_exit=early_exit), "pallas"
+            return make_pallas_sweep_fn(batch_size, difficulty_bits,
+                                        early_exit=early_exit), "pallas"
         except Exception as e:  # pallas unavailable on this platform
             from ..utils.logging import get_logger
             get_logger().warning(

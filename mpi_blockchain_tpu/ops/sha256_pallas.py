@@ -92,62 +92,84 @@ def _compress_unrolled(state, w):
 
 
 def _sweep_kernel(midstate_ref, tail_ref, base_ref, count_ref, min_ref, *,
-                  difficulty_bits: int):
+                  difficulty_bits: int, early_exit: bool):
     pid = pl.program_id(0)
-    base = base_ref[0] + (pid * np.uint32(TILE)).astype(_U32)
-    row = jax.lax.broadcasted_iota(_U32, (_ROWS, _LANES), 0)
-    lane = jax.lax.broadcasted_iota(_U32, (_ROWS, _LANES), 1)
-    nonces = base + row * np.uint32(_LANES) + lane
-
-    full = lambda v: jnp.full((_ROWS, _LANES), v, _U32)
-    # Chunk 2 of the first hash: constant words from SMEM, nonce in word 3.
-    w1 = [full(tail_ref[i]) if i != 3 else _bswap32(nonces)
-          for i in range(16)]
-    st1 = tuple(full(midstate_ref[i]) for i in range(8))
-    d1 = _compress_unrolled(st1, w1)
-    # Second hash: one padded chunk whose first 8 words are digest 1.
-    w2 = list(d1) + [full(np.uint32(0x80000000))] + [full(np.uint32(0))] * 6 \
-        + [full(np.uint32(256))]
-    st2 = tuple(full(np.uint32(v)) for v in IV)
-    d2 = _compress_unrolled(st2, w2)
-
-    # Leading-zero-bits difficulty check on the big-endian digest.
-    h0, h1 = d2[0], d2[1]
-    dbits = int(difficulty_bits)
-    if dbits <= 0:
-        qual = jnp.ones_like(h0, dtype=jnp.bool_)
-    elif dbits < 32:
-        qual = h0 < np.uint32(1 << (32 - dbits))
-    elif dbits == 32:
-        qual = h0 == np.uint32(0)
-    elif dbits < 64:
-        qual = (h0 == np.uint32(0)) & (h1 < np.uint32(1 << (64 - dbits)))
-    else:
-        qual = (h0 == np.uint32(0)) & (h1 == np.uint32(0))
 
     # The TPU grid runs sequentially on a core, so programs accumulate into
-    # one (1,1) SMEM cell: initialize at program 0, then reduce. Mosaic has
-    # no unsigned reductions, so the min runs on bias-flipped int32
-    # (x ^ 0x80000000 is order-isomorphic uint32 -> int32); the caller
-    # unbiases. The 0xFFFFFFFF sentinel biases to int32 max — the identity.
+    # one (1,1) SMEM cell: initialize at program 0, then reduce.
     @pl.when(pid == 0)
     def _():
         count_ref[0, 0] = jnp.int32(0)
         min_ref[0, 0] = jnp.int32(0x7FFFFFFF)
 
-    count_ref[0, 0] += jnp.sum(qual.astype(jnp.int32))
-    biased = jax.lax.bitcast_convert_type(
-        jnp.where(qual, nonces, NOT_FOUND_U32) ^ np.uint32(0x80000000),
-        jnp.int32)
-    min_ref[0, 0] = jnp.minimum(min_ref[0, 0], jnp.min(biased))
+    def tile():
+        base = base_ref[0] + (pid * np.uint32(TILE)).astype(_U32)
+        row = jax.lax.broadcasted_iota(_U32, (_ROWS, _LANES), 0)
+        lane = jax.lax.broadcasted_iota(_U32, (_ROWS, _LANES), 1)
+        nonces = base + row * np.uint32(_LANES) + lane
+
+        full = lambda v: jnp.full((_ROWS, _LANES), v, _U32)
+        # Chunk 2 of the first hash: constant words from SMEM, nonce in
+        # word 3.
+        w1 = [full(tail_ref[i]) if i != 3 else _bswap32(nonces)
+              for i in range(16)]
+        st1 = tuple(full(midstate_ref[i]) for i in range(8))
+        d1 = _compress_unrolled(st1, w1)
+        # Second hash: one padded chunk whose first 8 words are digest 1.
+        w2 = list(d1) + [full(np.uint32(0x80000000))] \
+            + [full(np.uint32(0))] * 6 + [full(np.uint32(256))]
+        st2 = tuple(full(np.uint32(v)) for v in IV)
+        d2 = _compress_unrolled(st2, w2)
+
+        # Leading-zero-bits difficulty check on the big-endian digest.
+        h0, h1 = d2[0], d2[1]
+        dbits = int(difficulty_bits)
+        if dbits <= 0:
+            qual = jnp.ones_like(h0, dtype=jnp.bool_)
+        elif dbits < 32:
+            qual = h0 < np.uint32(1 << (32 - dbits))
+        elif dbits == 32:
+            qual = h0 == np.uint32(0)
+        elif dbits < 64:
+            qual = (h0 == np.uint32(0)) & (h1 < np.uint32(1 << (64 - dbits)))
+        else:
+            qual = (h0 == np.uint32(0)) & (h1 == np.uint32(0))
+
+        # Mosaic has no unsigned reductions, so the min runs on bias-flipped
+        # int32 (x ^ 0x80000000 is order-isomorphic uint32 -> int32); the
+        # caller unbiases. The 0xFFFFFFFF sentinel biases to int32 max — the
+        # identity.
+        count_ref[0, 0] += jnp.sum(qual.astype(jnp.int32))
+        biased = jax.lax.bitcast_convert_type(
+            jnp.where(qual, nonces, NOT_FOUND_U32) ^ np.uint32(0x80000000),
+            jnp.int32)
+        min_ref[0, 0] = jnp.minimum(min_ref[0, 0], jnp.min(biased))
+
+    if early_exit:
+        # Tiles sweep ascending nonce ranges and the grid is sequential, so
+        # once any tile has recorded a qualifier every later tile holds only
+        # larger nonces — skipping their hash work cannot change min_nonce.
+        # count then means "qualifiers up to and including the first
+        # qualifying tile" (>0 iff the batch prefix contains a winner),
+        # which is all the mine loop consumes. Exact-count callers (the
+        # sweep API, the bench) keep early_exit=False.
+        @pl.when(count_ref[0, 0] == 0)
+        def _():
+            tile()
+    else:
+        tile()
 
 
 def pallas_sweep_core(midstate, tail_w, base_nonce, *, batch_size: int,
-                      difficulty_bits: int, interpret: bool = False):
+                      difficulty_bits: int, interpret: bool = False,
+                      early_exit: bool = False):
     """Sweeps [base_nonce, base_nonce + batch_size) on one TPU core.
 
     Same contract as sha256_jnp.sweep_core: returns (count, min_nonce).
-    batch_size must be a multiple of the 8192-nonce tile.
+    batch_size must be a multiple of the 8192-nonce tile. With
+    early_exit=True, tiles after the first qualifying tile are skipped:
+    min_nonce is unchanged (lowest-nonce determinism holds) but count is
+    only exact up to that tile — use where count is just a found-flag.
     """
     if batch_size % TILE != 0:
         raise ValueError(f"batch_size {batch_size} not a multiple of {TILE}")
@@ -165,7 +187,8 @@ def pallas_sweep_core(midstate, tail_w, base_nonce, *, batch_size: int,
         ],
     )
     count, min_biased = pl.pallas_call(
-        functools.partial(_sweep_kernel, difficulty_bits=difficulty_bits),
+        functools.partial(_sweep_kernel, difficulty_bits=difficulty_bits,
+                          early_exit=early_exit),
         out_shape=[jax.ShapeDtypeStruct((1, 1), jnp.int32),
                    jax.ShapeDtypeStruct((1, 1), jnp.int32)],
         grid_spec=grid_spec,
@@ -178,12 +201,16 @@ def pallas_sweep_core(midstate, tail_w, base_nonce, *, batch_size: int,
 
 
 def make_pallas_sweep_fn(batch_size: int, difficulty_bits: int,
-                         interpret: bool = False):
+                         interpret: bool = False, early_exit: bool = False):
     """jit'd (midstate, tail_w, base_nonce) -> (count, min_nonce)."""
+    if batch_size % TILE != 0:
+        raise ValueError(f"batch_size {batch_size} not a multiple of {TILE}")
+
     @jax.jit
     def fn(midstate, tail_w, base_nonce):
         return pallas_sweep_core(midstate, tail_w, base_nonce,
                                  batch_size=batch_size,
                                  difficulty_bits=difficulty_bits,
-                                 interpret=interpret)
+                                 interpret=interpret,
+                                 early_exit=early_exit)
     return fn

@@ -37,3 +37,31 @@ def test_pallas_matches_oracle():
 def test_pallas_batch_validation():
     with pytest.raises(ValueError):
         make_pallas_sweep_fn(TILE + 1, 8)
+
+
+def test_pallas_early_exit_same_min():
+    """early_exit skips post-winner tiles but min_nonce must not change."""
+    hdr = bytes(range(80))
+    midstate, tail = core.header_midstate(hdr)
+    exact = make_pallas_sweep_fn(TILE * 4, 8)
+    lazy = make_pallas_sweep_fn(TILE * 4, 8, early_exit=True)
+    c1, m1 = exact(midstate, tail, np.uint32(0))
+    c2, m2 = lazy(midstate, tail, np.uint32(0))
+    assert int(c1) > 0, "difficulty 8 must qualify within 4 tiles"
+    assert int(m1) == int(m2)
+    assert int(c2) > 0
+    # count is exact through the first qualifying tile (ascending order).
+    first_tile_end = (int(m1) // TILE + 1) * TILE
+    qual_prefix = sum(core.leading_zero_bits(
+        core.header_hash(core.set_nonce(hdr, n))) >= 8
+        for n in range(first_tile_end))
+    assert int(c2) == qual_prefix
+
+
+def test_pallas_early_exit_not_found():
+    hdr = bytes(range(80))
+    midstate, tail = core.header_midstate(hdr)
+    lazy = make_pallas_sweep_fn(TILE, 40, early_exit=True)
+    count, mn = lazy(midstate, tail, np.uint32(0))
+    assert int(count) == 0
+    assert int(mn) == 0xFFFFFFFF
