@@ -102,11 +102,13 @@ def bench_tpu(seconds: float = 5.0, batch_pow2: int = 28,
 
 
 def bench_chain(n_blocks: int = 1000, difficulty_bits: int = 24,
-                batch_pow2: int = 24, blocks_per_call: int = 100) -> dict:
+                batch_pow2: int = 24, blocks_per_call: int = 100,
+                n_miners: int = 1, kernel: str = "auto") -> dict:
     """Wall-clock to mine a full chain — the metric's second half.
 
     Uses the fused device-resident miner (models/fused.py) and validates
-    the resulting chain before reporting.
+    the resulting chain before reporting. n_miners > 1 runs the sharded
+    mine loop over the ('miners',) mesh.
     """
     import time as _time
 
@@ -114,7 +116,8 @@ def bench_chain(n_blocks: int = 1000, difficulty_bits: int = 24,
     from .models.fused import FusedMiner
 
     cfg = MinerConfig(difficulty_bits=difficulty_bits, n_blocks=n_blocks,
-                      batch_pow2=batch_pow2, backend="tpu")
+                      batch_pow2=batch_pow2, backend="tpu",
+                      n_miners=n_miners, kernel=kernel)
     miner = FusedMiner(cfg, blocks_per_call=blocks_per_call)
     miner.warmup()
     if n_blocks % blocks_per_call:    # the remainder chunk is its own program
@@ -129,7 +132,8 @@ def bench_chain(n_blocks: int = 1000, difficulty_bits: int = 24,
     if not core.Node(difficulty_bits, 0).load(node.save()):
         raise RuntimeError("mined chain failed validation")
     return {"n_blocks": n_blocks, "difficulty_bits": difficulty_bits,
-            "wall_s": round(wall, 3), "blocks_per_sec": n_blocks / wall,
+            "n_miners": n_miners, "wall_s": round(wall, 3),
+            "blocks_per_sec": n_blocks / wall,
             "tip_hash": node.tip_hash.hex()}
 
 

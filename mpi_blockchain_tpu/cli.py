@@ -43,6 +43,8 @@ def _config_from(args) -> MinerConfig:
 
 
 def cmd_mine(args) -> int:
+    import contextlib
+
     from .models.miner import Miner
     from .utils.logging import get_logger
 
@@ -54,12 +56,31 @@ def cmd_mine(args) -> int:
         miner = FusedMiner(cfg, blocks_per_call=args.blocks_per_call)
     else:
         miner = Miner(cfg)
+    if args.resume:
+        from .utils.checkpoint import load_chain
+        try:
+            miner.node = load_chain(args.resume, cfg.difficulty_bits)
+        except (OSError, ValueError) as e:
+            print(json.dumps({"event": "chain_mined", "error": str(e)},
+                             sort_keys=True))
+            return 1
+    # --blocks is the TARGET height, so a resumed run mines the remainder
+    # (equal to "blocks to mine" when starting from genesis).
+    remaining = max(0, cfg.n_blocks - miner.node.height)
+    profile_ctx = contextlib.nullcontext()
+    if args.profile:
+        from .utils.profiling import trace_mining
+        profile_ctx = trace_mining(args.profile)
     t0 = time.perf_counter()
-    miner.mine_chain()
+    with profile_ctx:
+        miner.mine_chain(remaining)
     wall = time.perf_counter() - t0
     if args.out:
         with open(args.out, "wb") as f:
             f.write(miner.node.save())
+    if args.checkpoint:
+        from .utils.checkpoint import save_chain
+        save_chain(miner.node, args.checkpoint, cfg)
     summary = {
         "event": "chain_mined",
         "config": dataclasses.asdict(cfg),
@@ -146,11 +167,22 @@ def cmd_info(args) -> int:
 
 
 def cmd_bench(args) -> int:
-    from .bench_lib import run_bench
+    from .bench_lib import bench_chain, run_bench
 
-    result = run_bench(backend=args.backend, seconds=args.seconds,
-                       batch_pow2=args.batch_pow2, n_miners=args.miners,
-                       kernel=args.kernel)
+    if args.mode == "chain":
+        result = bench_chain(n_blocks=args.blocks,
+                             difficulty_bits=args.difficulty,
+                             batch_pow2=(args.batch_pow2
+                                         if args.batch_pow2 is not None
+                                         else 24),
+                             blocks_per_call=args.blocks_per_call,
+                             n_miners=args.miners, kernel=args.kernel)
+    else:
+        result = run_bench(backend=args.backend, seconds=args.seconds,
+                           batch_pow2=(args.batch_pow2
+                                       if args.batch_pow2 is not None
+                                       else 28),
+                           n_miners=args.miners, kernel=args.kernel)
     print(json.dumps(result, sort_keys=True))
     return 0
 
@@ -168,6 +200,13 @@ def main(argv: list[str] | None = None) -> int:
                         help="device-resident multi-block mine loop "
                              "(one device call per --blocks-per-call)")
     p_mine.add_argument("--blocks-per-call", type=int, default=16)
+    p_mine.add_argument("--checkpoint",
+                        help="save the chain + config sidecar here when done")
+    p_mine.add_argument("--resume",
+                        help="load this checkpoint and mine up to --blocks")
+    p_mine.add_argument("--profile",
+                        help="capture a jax.profiler device trace into this "
+                             "logdir (view with ui.perfetto.dev)")
     p_mine.set_defaults(fn=cmd_mine)
 
     p_verify = sub.add_parser("verify", help="validate a saved chain file")
@@ -175,16 +214,29 @@ def main(argv: list[str] | None = None) -> int:
     p_verify.add_argument("--difficulty", type=int, required=True)
     p_verify.set_defaults(fn=cmd_verify)
 
-    p_bench = sub.add_parser("bench", help="raw hashes/sec measurement")
+    p_bench = sub.add_parser(
+        "bench", help="raw hashes/sec (--mode sweep) or full-chain "
+                      "wall-clock (--mode chain) measurement")
+    p_bench.add_argument("--mode", choices=["sweep", "chain"],
+                         default="sweep",
+                         help="sweep: raw rate for --seconds; chain: mine "
+                              "--blocks at --difficulty with the fused "
+                              "device miner (--backend/--seconds ignored)")
     p_bench.add_argument("--backend", choices=["cpu", "tpu"], default="tpu")
     p_bench.add_argument("--seconds", type=float, default=5.0)
-    # 28, not 20: below ~2^26 nonces/dispatch the measurement is dominated
-    # by per-dispatch overhead, not the kernel (see ops/sha256_pallas.py).
-    # bench_tpu clamps to 2^22 on CPU-only hosts.
-    p_bench.add_argument("--batch-pow2", type=int, default=28)
+    # sweep default 28, not 20: below ~2^26 nonces/dispatch the measurement
+    # is dominated by per-dispatch overhead, not the kernel (see
+    # ops/sha256_pallas.py); bench_tpu clamps to 2^22 on CPU-only hosts.
+    # chain default 24: the early-exit sweet spot at difficulty 24.
+    p_bench.add_argument("--batch-pow2", type=int, default=None)
     p_bench.add_argument("--miners", type=int, default=1)
     p_bench.add_argument("--kernel", choices=["auto", "jnp", "pallas"],
                          default="auto")
+    p_bench.add_argument("--blocks", type=int, default=1000,
+                         help="chain mode: blocks to mine")
+    p_bench.add_argument("--difficulty", type=int, default=24,
+                         help="chain mode: leading-zero bits")
+    p_bench.add_argument("--blocks-per-call", type=int, default=100)
     p_bench.set_defaults(fn=cmd_bench)
 
     p_sim = sub.add_parser(

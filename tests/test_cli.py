@@ -98,3 +98,59 @@ def test_config_from_preset():
 
     ns = argparse.Namespace(preset="tpu-mesh8")
     assert _config_from(ns) == PRESETS["tpu-mesh8"]
+
+
+def test_cli_checkpoint_resume(tmp_path, capsys):
+    ck = tmp_path / "ck.bin"
+    rc = main(["mine", "--difficulty", "8", "--blocks", "2", "--backend",
+               "cpu", "--checkpoint", str(ck)])
+    assert rc == 0
+    assert ck.exists() and ck.with_suffix(".bin.json").exists()
+    capsys.readouterr()
+    # Resume to target height 4; the result must equal a fresh 4-block mine.
+    rc = main(["mine", "--difficulty", "8", "--blocks", "4", "--backend",
+               "cpu", "--resume", str(ck), "--out", str(tmp_path / "r.bin")])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0 and out["height"] == 4
+    rc = main(["mine", "--difficulty", "8", "--blocks", "4", "--backend",
+               "cpu", "--out", str(tmp_path / "f.bin")])
+    capsys.readouterr()
+    assert (tmp_path / "r.bin").read_bytes() == (tmp_path / "f.bin").read_bytes()
+    # Difficulty mismatch must refuse, not mine an invalid suffix.
+    rc = main(["mine", "--difficulty", "9", "--blocks", "4", "--backend",
+               "cpu", "--resume", str(ck)])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1 and "difficulty" in out["error"]
+    # Missing checkpoint: clean JSON error.
+    rc = main(["mine", "--difficulty", "8", "--blocks", "4", "--backend",
+               "cpu", "--resume", str(tmp_path / "nope.bin")])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1 and "error" in out
+
+
+def test_cli_resume_already_at_target(tmp_path, capsys):
+    ck = tmp_path / "ck.bin"
+    main(["mine", "--difficulty", "8", "--blocks", "3", "--backend", "cpu",
+          "--checkpoint", str(ck)])
+    capsys.readouterr()
+    rc = main(["mine", "--difficulty", "8", "--blocks", "2", "--backend",
+               "cpu", "--resume", str(ck)])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0 and out["height"] == 3  # nothing to mine, nothing lost
+
+
+def test_cli_bench_chain_mode(capsys):
+    rc = main(["bench", "--mode", "chain", "--blocks", "3", "--difficulty",
+               "6", "--batch-pow2", "11", "--blocks-per-call", "2"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert out["n_blocks"] == 3 and out["difficulty_bits"] == 6
+    assert out["wall_s"] > 0
+
+
+def test_cli_profile_flag(tmp_path, capsys):
+    trace_dir = tmp_path / "trace"
+    rc = main(["mine", "--difficulty", "6", "--blocks", "1", "--backend",
+               "cpu", "--profile", str(trace_dir)])
+    assert rc == 0
+    assert any(trace_dir.rglob("*")), "profiler wrote no trace files"
