@@ -51,19 +51,61 @@ def cmd_mine(args) -> int:
     cfg = _config_from(args)
     if args.verbose:
         get_logger().setLevel("DEBUG")
+    mesh = None
+    is_main = True
+    if args.coordinator:
+        # Multi-process launch — the reference's `mpirun -np N` across
+        # hosts. Every process runs this same program over one global
+        # ('miners',) mesh; XLA routes winner-select over ICI/DCN.
+        import jax
+
+        from .parallel.distributed import (init_distributed,
+                                           make_global_miner_mesh)
+        init_distributed(args.coordinator, args.num_processes,
+                         args.process_id)
+        mesh = make_global_miner_mesh()
+        cfg = dataclasses.replace(cfg, backend="tpu",
+                                  n_miners=len(jax.devices()))
+        is_main = jax.process_index() == 0
     if args.fused:
         from .models.fused import FusedMiner
-        miner = FusedMiner(cfg, blocks_per_call=args.blocks_per_call)
+        miner = FusedMiner(cfg, blocks_per_call=args.blocks_per_call,
+                           mesh=mesh)
+    elif mesh is not None:
+        from .backend import get_backend
+        miner = Miner(cfg, backend=get_backend(
+            "tpu", batch_pow2=cfg.batch_pow2, n_miners=cfg.n_miners,
+            kernel=cfg.kernel, mesh=mesh))
     else:
         miner = Miner(cfg)
     if args.resume:
         from .utils.checkpoint import load_chain
+        node, err = None, None
         try:
-            miner.node = load_chain(args.resume, cfg.difficulty_bits)
+            node = load_chain(args.resume, cfg.difficulty_bits)
         except (OSError, ValueError) as e:
-            print(json.dumps({"event": "chain_mined", "error": str(e)},
+            err = str(e)
+        if mesh is not None:
+            # Every process must resume from the SAME chain state, or they
+            # issue different numbers of collective mine rounds and the
+            # world deadlocks. Agree before the first device call; abort
+            # everywhere on any failure or divergence.
+            import numpy as np
+            from jax.experimental import multihost_utils
+
+            tip = node.tip_hash[:8] if node is not None else b"\0" * 8
+            state = np.array([err is None,
+                              node.height if node is not None else -1,
+                              *tip], dtype=np.int64)
+            rows = multihost_utils.process_allgather(state)
+            if not (rows == rows[0]).all():
+                err = (f"resume state diverges across processes "
+                       f"(this process: {err or 'ok'})")
+        if err is not None:
+            print(json.dumps({"event": "chain_mined", "error": err},
                              sort_keys=True))
             return 1
+        miner.node = node
     # --blocks is the TARGET height, so a resumed run mines the remainder
     # (equal to "blocks to mine" when starting from genesis).
     remaining = max(0, cfg.n_blocks - miner.node.height)
@@ -75,6 +117,8 @@ def cmd_mine(args) -> int:
     with profile_ctx:
         miner.mine_chain(remaining)
     wall = time.perf_counter() - t0
+    if not is_main:      # non-zero processes mine but stay silent
+        return 0
     if args.out:
         with open(args.out, "wb") as f:
             f.write(miner.node.save())
@@ -207,6 +251,14 @@ def main(argv: list[str] | None = None) -> int:
     p_mine.add_argument("--profile",
                         help="capture a jax.profiler device trace into this "
                              "logdir (view with ui.perfetto.dev)")
+    p_mine.add_argument("--coordinator",
+                        help="multi-process launch: coordinator host:port "
+                             "(run the same command on every host; the "
+                             "mpirun -np N equivalent)")
+    p_mine.add_argument("--num-processes", type=int, default=None,
+                        help="multi-process launch: world size")
+    p_mine.add_argument("--process-id", type=int, default=None,
+                        help="multi-process launch: this host's rank")
     p_mine.set_defaults(fn=cmd_mine)
 
     p_verify = sub.add_parser("verify", help="validate a saved chain file")

@@ -35,6 +35,7 @@ from jax.sharding import PartitionSpec as P
 from .. import core
 from ..ops.sha256_jnp import (IV, NOT_FOUND_U32, _bswap32, compress,
                               sha256d_words_from_midstate)
+from ..parallel.mesh import replicated_host_value
 
 _U32 = jnp.uint32
 _VERSION_WORD = np.uint32(0x01000000)  # bswap32 of version=1 (LE bytes)
@@ -194,7 +195,7 @@ class FusedMiner:
             nonces, _ = self._fn(k)(jnp.asarray(prev_words),
                                     jnp.asarray(data_words),
                                     np.uint32(start_height))
-            nonces = np.asarray(nonces)
+            nonces = replicated_host_value(nonces)
             for j in range(k):
                 cand = self.node.make_candidate(payloads[j])
                 winner = core.set_nonce(cand, int(nonces[j]))

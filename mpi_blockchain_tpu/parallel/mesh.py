@@ -24,6 +24,18 @@ from jax.sharding import Mesh, PartitionSpec as P
 _U32 = jnp.uint32
 
 
+def replicated_host_value(x) -> np.ndarray:
+    """Host numpy value of a replicated (out_specs=P()) sharded output.
+
+    Single-process arrays convert directly; on a multi-process (multi-host)
+    mesh the global array is not fully addressable, but every process's
+    local shard of a replicated output is the full value.
+    """
+    if getattr(x, "is_fully_addressable", True):
+        return np.asarray(x)
+    return np.asarray(x.addressable_data(0))
+
+
 def make_miner_mesh(n_miners: int) -> Mesh:
     """A 1-D ('miners',) mesh over the first n_miners local devices."""
     devices = jax.devices()
@@ -83,4 +95,5 @@ class MeshSweeper:
             self._fns[difficulty_bits] = fn
         count, gmin = fn(jnp.asarray(midstate), jnp.asarray(tail_w),
                          np.uint32(base))
-        return int(count), int(gmin)
+        return (int(replicated_host_value(count)),
+                int(replicated_host_value(gmin)))
