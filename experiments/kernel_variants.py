@@ -13,6 +13,10 @@ the production defaults in ops/sha256_pallas.py and bench.py:
     +4% at the plateau, adopted into _compress_unrolled.
   * A 32-round (wrong-hash) probe was NOT faster at small batches —
     proof the small-batch regime is dispatch-bound, not compute-bound.
+  * Keeping uniform words scalar (SMEM values / numpy constants) instead
+    of pre-broadcast splats: 971.8 MH/s at 2^28, +0.2% — Mosaic was
+    already folding splat arithmetic; kept for kernel simplicity. The
+    plateau is genuinely VPU-ALU-bound.
 
 This driver imports the PRODUCTION kernel so it cannot go stale; re-run it
 after any kernel change: python experiments/kernel_variants.py
