@@ -64,3 +64,40 @@ def test_chain_validity_after_convergence():
     check = core.Node(CFG.difficulty_bits, 99)
     assert check.load(blob)
     assert check.tip_hash == net.nodes[1].node.tip_hash
+
+
+def test_byzantine_bad_pow_rejected():
+    """A well-formed block whose hash misses the difficulty is INVALID."""
+    from mpi_blockchain_tpu import core
+
+    net = make_net(2)
+    net.run(target_height=3, nonce_budget=1 << 8)
+    evil = net.nodes[0].node.make_candidate(b"byzantine")
+    nz = 0  # find a nonce that FAILS the difficulty (almost surely nz=0)
+    while core.leading_zero_bits(core.header_hash(
+            core.set_nonce(evil, nz))) >= CFG.difficulty_bits:
+        nz += 1
+    victim = net.nodes[1]
+    h, tip = victim.node.height, victim.node.tip_hash
+    assert victim.node.receive(core.set_nonce(evil, nz)) \
+        == core.RecvResult.INVALID
+    assert victim.node.height == h and victim.node.tip_hash == tip
+
+
+def test_byzantine_orphan_with_valid_pow_does_not_corrupt():
+    """Valid-PoW block on a bogus parent: the fetch-and-adopt path must
+    leave the victim's chain untouched when the sender cannot substantiate
+    a longer valid chain."""
+    from mpi_blockchain_tpu import core
+
+    net = make_net(2)
+    net.run(target_height=3, nonce_budget=1 << 8)
+    victim = net.nodes[1]
+    cand = victim.node.make_candidate(b"orphan")
+    fake = cand[:4] + b"\xab" * 32 + cand[36:]      # unknown predecessor
+    nonce, _ = core.cpu_search(fake, 0, 1 << 20, CFG.difficulty_bits)
+    assert nonce is not None
+    h, tip = victim.node.height, victim.node.tip_hash
+    victim.receive(core.set_nonce(fake, nonce),
+                   net.nodes[0].node.all_headers)
+    assert victim.node.height == h and victim.node.tip_hash == tip
