@@ -179,7 +179,10 @@ def cmd_sim(args) -> int:
         net = run_adversarial(config=cfg,
                               partition_steps=args.partition_steps,
                               target_height=target_height,
-                              nonce_budget=1 << args.nonce_budget_pow2)
+                              nonce_budget=1 << args.nonce_budget_pow2,
+                              delay_steps=args.delay_steps,
+                              drop_rate_pct=args.drop_rate,
+                              seed=args.seed)
     except RuntimeError as e:  # Network.run: no convergence in max_steps
         print(json.dumps({"event": "sim_done", "converged": False,
                           "error": str(e)}, sort_keys=True))
@@ -307,6 +310,12 @@ def main(argv: list[str] | None = None) -> int:
                        help="steps the 2 groups stay partitioned")
     p_sim.add_argument("--nonce-budget-pow2", type=int, default=8,
                        help="log2 nonces each group tries per sim step")
+    p_sim.add_argument("--delay-steps", type=int, default=1,
+                       help="delivery delay in sim steps")
+    p_sim.add_argument("--drop-rate", type=int, default=0,
+                       help="%% of deliveries dropped (seeded, deterministic)")
+    p_sim.add_argument("--seed", type=int, default=0,
+                       help="seed for the drop schedule")
     p_sim.set_defaults(fn=cmd_sim)
 
     p_info = sub.add_parser("info", help="world/topology introspection "

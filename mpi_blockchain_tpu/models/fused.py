@@ -141,7 +141,7 @@ class FusedMiner:
     """
 
     def __init__(self, config, node_id: int = 0, blocks_per_call: int = 16,
-                 mesh=None):
+                 mesh=None, log_fn=None):
         if blocks_per_call < 1:
             raise ValueError(
                 f"blocks_per_call must be >= 1, got {blocks_per_call}")
@@ -150,6 +150,10 @@ class FusedMiner:
         self.blocks_per_call = blocks_per_call
         self._mesh = mesh
         self._fns: dict[int, object] = {}
+        if log_fn is None:
+            from ..utils.logging import block_logger
+            log_fn = block_logger()
+        self._log = log_fn
 
     def _fn(self, k: int):
         fn = self._fns.get(k)
@@ -203,6 +207,10 @@ class FusedMiner:
                     raise RuntimeError(
                         f"fused miner produced an invalid block at height "
                         f"{start_height + j + 1} (nonce {int(nonces[j])})")
+                self._log({"event": "block_mined", "backend": "tpu-fused",
+                           "height": start_height + j + 1,
+                           "nonce": int(nonces[j]),
+                           "hash": self.node.tip_hash.hex()})
             n -= k
 
     def chain_hashes(self) -> list[str]:

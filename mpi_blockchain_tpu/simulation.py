@@ -194,18 +194,41 @@ class Network:
         return len(tips) == 1
 
 
+def seeded_drop(drop_rate_pct: int, seed: int = 0
+                ) -> Callable[[int, int, int], bool]:
+    """Deterministic pseudo-random drop_fn: ~drop_rate_pct% of deliveries.
+
+    Keyed by (step, sender, receiver, seed) through crc32, so a run with
+    the same faults schedule is exactly reproducible (the simulation's
+    determinism contract) with no global RNG state.
+    """
+    import struct
+    import zlib
+
+    def drop(step: int, sender: int, receiver: int) -> bool:
+        key = struct.pack("<IIII", step, sender, receiver, seed)
+        return zlib.crc32(key) % 100 < drop_rate_pct
+
+    return drop
+
+
 def run_adversarial(config: MinerConfig | None = None,
                     partition_steps: int = 30, target_height: int = 8,
-                    nonce_budget: int = 1 << 8) -> Network:
+                    nonce_budget: int = 1 << 8, delay_steps: int = 1,
+                    drop_rate_pct: int = 0, seed: int = 0) -> Network:
     """BASELINE config 5: two competing miner groups, then reconciliation.
 
     Two groups mine in a partition (building competing chains with different
     payloads), the partition heals, and longest-chain reorg resolution must
-    converge every node onto one chain.
+    converge every node onto one chain — optionally under delivery delay
+    and seeded random message loss on top of the partition.
     """
     cfg = config if config is not None else MinerConfig(
         difficulty_bits=8, n_blocks=target_height, backend="cpu")
     nodes = [SimNode(0, cfg), SimNode(1, cfg)]
-    net = Network(nodes, delay_steps=1, partitioned_until=partition_steps)
+    net = Network(nodes, delay_steps=delay_steps,
+                  drop_fn=(seeded_drop(drop_rate_pct, seed)
+                           if drop_rate_pct else None),
+                  partitioned_until=partition_steps)
     net.run(target_height, nonce_budget=nonce_budget)
     return net

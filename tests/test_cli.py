@@ -154,3 +154,10 @@ def test_cli_profile_flag(tmp_path, capsys):
                "cpu", "--profile", str(trace_dir)])
     assert rc == 0
     assert any(trace_dir.rglob("*")), "profiler wrote no trace files"
+
+
+def test_cli_sim_drop_and_delay_flags(capsys):
+    rc = main(["sim", "--blocks", "4", "--partition-steps", "10",
+               "--delay-steps", "2", "--drop-rate", "25", "--seed", "3"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0 and out["converged"] is True

@@ -101,3 +101,14 @@ def test_byzantine_orphan_with_valid_pow_does_not_corrupt():
     victim.receive(core.set_nonce(fake, nonce),
                    net.nodes[0].node.all_headers)
     assert victim.node.height == h and victim.node.tip_hash == tip
+
+
+def test_seeded_drop_faults_converge_deterministically():
+    n1 = run_adversarial(partition_steps=15, target_height=5,
+                         drop_rate_pct=30, seed=7)
+    n2 = run_adversarial(partition_steps=15, target_height=5,
+                         drop_rate_pct=30, seed=7)
+    assert n1.converged() and n2.converged()
+    assert [n.node.tip_hash for n in n1.nodes] == \
+           [n.node.tip_hash for n in n2.nodes]
+    assert n1.step_count == n2.step_count
