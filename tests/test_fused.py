@@ -55,3 +55,29 @@ def test_fused_fn_outputs_match_host_hash():
     expect = core.header_hash(winner)
     got = b"".join(int(w).to_bytes(4, "big") for w in np.asarray(tip))
     assert got == expect
+
+
+def test_fused_warmup_aot_identical(oracle_chain):
+    """AOT-compiled executable (bench path) mines the same chain; warmup
+    is idempotent."""
+    cfg = MinerConfig(difficulty_bits=DIFF, n_blocks=6, batch_pow2=12,
+                      backend="tpu", kernel="jnp")
+    fm = FusedMiner(cfg, blocks_per_call=3)
+    fm.warmup()
+    fm.warmup()
+    fm.mine_chain()
+    assert fm.chain_hashes() == oracle_chain.chain_hashes()
+
+
+def test_fused_search_failure_surfaces():
+    """A capped, hopeless search must raise, not append garbage."""
+    from mpi_blockchain_tpu.models.fused import make_fused_miner
+
+    cfg = MinerConfig(difficulty_bits=40, n_blocks=1, batch_pow2=9,
+                      backend="tpu", kernel="jnp")
+    fm = FusedMiner(cfg, blocks_per_call=1)
+    fm._fns[1] = make_fused_miner(1, cfg.batch_pow2, cfg.difficulty_bits,
+                                  kernel="jnp", max_rounds=2)
+    with pytest.raises(RuntimeError, match="invalid block"):
+        fm.mine_chain()
+    assert fm.node.height == 0
