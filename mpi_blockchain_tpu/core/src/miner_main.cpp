@@ -1,0 +1,131 @@
+// Standalone native miner — the reference's single-binary launch form
+// (SURVEY.md §1 layer 7: `mpirun -np N binary difficulty n_blocks`), built
+// on the same chain core the Python framework binds. Ranks are threads
+// sweeping disjoint contiguous nonce slices per round; the first round with
+// any qualifier yields the exact global lowest nonce, so the mined chain is
+// byte-identical to every other backend (the determinism contract):
+//
+//   ./chaincore_miner <difficulty_bits> <n_blocks> [n_threads] [out_file]
+//
+// Payloads are "block:<height>" — the Python MinerConfig default — so
+// `python -m mpi_blockchain_tpu mine --difficulty D --blocks N --out f`
+// and `./chaincore_miner D N T f` produce the same bytes.
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "chain.hpp"
+#include "sha256.hpp"
+
+using namespace chaincore;
+
+namespace {
+
+// Lowest qualifying nonce in [start, start+count), or UINT64_MAX.
+uint64_t search_range(const BlockHeader& header, uint64_t start,
+                      uint64_t count, std::atomic<uint64_t>* tried) {
+  BlockHeader h = header;
+  uint8_t digest[32];
+  uint64_t end = start + count;
+  uint64_t local = 0;
+  for (uint64_t n = start; n < end; ++n) {
+    h.nonce = static_cast<uint32_t>(n);
+    h.hash(digest);
+    ++local;
+    if (leading_zero_bits(digest) >= static_cast<int>(h.bits)) {
+      tried->fetch_add(local, std::memory_order_relaxed);
+      return n;
+    }
+  }
+  tried->fetch_add(local, std::memory_order_relaxed);
+  return UINT64_MAX;
+}
+
+uint64_t mine_block(const BlockHeader& cand, int n_threads, uint64_t slice,
+                    std::atomic<uint64_t>* tried) {
+  for (uint64_t base = 0; base < (1ull << 32); base += n_threads * slice) {
+    std::vector<uint64_t> found(n_threads, UINT64_MAX);
+    std::vector<std::thread> threads;
+    for (int t = 0; t < n_threads; ++t) {
+      threads.emplace_back([&, t] {
+        found[t] = search_range(cand, base + t * slice, slice, tried);
+      });
+    }
+    for (auto& th : threads) th.join();
+    uint64_t best = UINT64_MAX;
+    for (uint64_t f : found)
+      if (f < best) best = f;
+    if (best != UINT64_MAX) return best;
+  }
+  return UINT64_MAX;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc < 3) {
+    std::fprintf(stderr,
+                 "usage: %s <difficulty_bits> <n_blocks> [n_threads] "
+                 "[out_file]\n", argv[0]);
+    return 2;
+  }
+  const uint32_t difficulty = std::strtoul(argv[1], nullptr, 10);
+  const uint64_t n_blocks = std::strtoull(argv[2], nullptr, 10);
+  const int n_threads = argc > 3 ? std::atoi(argv[3]) : 1;
+  const char* out_file = argc > 4 ? argv[4] : nullptr;
+  if (difficulty > 64 || n_threads < 1) {
+    std::fprintf(stderr, "bad arguments\n");
+    return 2;
+  }
+
+  Node node(difficulty, 0);
+  std::atomic<uint64_t> tried{0};
+  const uint64_t slice = 1ull << 16;
+
+  for (uint64_t b = 1; b <= n_blocks; ++b) {
+    char payload[32];
+    int len = std::snprintf(payload, sizeof payload, "block:%llu",
+                            static_cast<unsigned long long>(b));
+    BlockHeader cand = node.make_candidate(
+        reinterpret_cast<const uint8_t*>(payload), len);
+    uint64_t nonce = mine_block(cand, n_threads, slice, &tried);
+    if (nonce == UINT64_MAX) {
+      std::fprintf(stderr, "nonce space exhausted at height %llu\n",
+                   static_cast<unsigned long long>(b));
+      return 1;
+    }
+    cand.nonce = static_cast<uint32_t>(nonce);
+    if (!node.submit(cand)) {
+      std::fprintf(stderr, "submit failed at height %llu\n",
+                   static_cast<unsigned long long>(b));
+      return 1;
+    }
+  }
+
+  uint8_t tip[32];
+  node.chain().tip().header.hash(tip);
+  char hex[65];
+  for (int i = 0; i < 32; ++i) std::snprintf(hex + 2 * i, 3, "%02x", tip[i]);
+  std::printf("{\"event\": \"chain_mined\", \"backend\": \"cpp-binary\", "
+              "\"height\": %llu, \"tip_hash\": \"%s\", "
+              "\"hashes_tried\": %llu, \"n_threads\": %d}\n",
+              static_cast<unsigned long long>(node.height()), hex,
+              static_cast<unsigned long long>(tried.load()), n_threads);
+
+  if (out_file) {
+    std::vector<uint8_t> bytes = node.chain().save();
+    std::FILE* f = std::fopen(out_file, "wb");
+    if (!f || std::fwrite(bytes.data(), 1, bytes.size(), f) != bytes.size()) {
+      std::fprintf(stderr, "cannot write %s\n", out_file);
+      if (f) std::fclose(f);
+      return 1;
+    }
+    std::fclose(f);
+  }
+  return 0;
+}

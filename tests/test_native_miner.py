@@ -1,0 +1,47 @@
+"""The standalone C++ miner binary vs the Python framework.
+
+The reference's launch form is a single native binary; chaincore_miner is
+its rebuild on the same chain core. Its chain bytes must be identical to
+the Python CLI's for the same (difficulty, blocks) — the determinism
+contract across the language boundary — and loadable by `verify`.
+"""
+import pathlib
+import subprocess
+
+from mpi_blockchain_tpu.cli import main
+from mpi_blockchain_tpu.config import MinerConfig
+from mpi_blockchain_tpu.models.miner import Miner
+
+CORE = pathlib.Path(__file__).resolve().parent.parent / \
+    "mpi_blockchain_tpu" / "core"
+DIFF, BLOCKS = 10, 3
+
+
+def _build() -> pathlib.Path:
+    subprocess.run(["make", "miner"], cwd=CORE, check=True,
+                   capture_output=True)
+    return CORE / "chaincore_miner"
+
+
+def test_binary_chain_identical_to_python(tmp_path, capsys):
+    binary = _build()
+    out = tmp_path / "cpp.bin"
+    r = subprocess.run([str(binary), str(DIFF), str(BLOCKS), "4", str(out)],
+                       capture_output=True, text=True, check=True)
+    assert '"backend": "cpp-binary"' in r.stdout
+
+    miner = Miner(MinerConfig(difficulty_bits=DIFF, n_blocks=BLOCKS,
+                              backend="cpu"))
+    miner.mine_chain()
+    assert out.read_bytes() == miner.node.save()
+
+    rc = main(["verify", "--chain", str(out), "--difficulty", str(DIFF)])
+    assert rc == 0
+    assert '"valid": true' in capsys.readouterr().out
+
+
+def test_binary_bad_args():
+    binary = _build()
+    assert subprocess.run([str(binary)], capture_output=True).returncode == 2
+    assert subprocess.run([str(binary), "99", "1"],
+                          capture_output=True).returncode == 2
