@@ -323,7 +323,15 @@ def main(argv: list[str] | None = None) -> int:
     p_info.set_defaults(fn=cmd_info)
 
     args = parser.parse_args(argv)
-    return args.fn(args)
+    try:
+        return args.fn(args)
+    except ValueError as e:
+        # Config/topology errors (oversubscribed mesh, bad kernel/batch,
+        # invalid checkpoint) surface as one clean JSON line, not a
+        # traceback — the launch-form contract of the reference's CLI.
+        print(json.dumps({"event": "error", "error": str(e)},
+                         sort_keys=True))
+        return 2
 
 
 if __name__ == "__main__":

@@ -161,3 +161,10 @@ def test_cli_sim_drop_and_delay_flags(capsys):
                "--delay-steps", "2", "--drop-rate", "25", "--seed", "3"])
     out = json.loads(capsys.readouterr().out)
     assert rc == 0 and out["converged"] is True
+
+
+def test_cli_oversubscribed_mesh_clean_error(capsys):
+    rc = main(["mine", "--difficulty", "8", "--blocks", "1", "--backend",
+               "tpu", "--kernel", "jnp", "--miners", "9"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 2 and "9 devices" in out["error"]
