@@ -5,56 +5,91 @@ TPU total rate / 8-rank CPU total rate (the mpirun -np 8 stand-in: 8 C++
 threads running the scalar miner loop with the GIL released — OpenMPI is not
 in this image; documented in BASELINE.md).
 
-Runs on whatever JAX platform is default (the real TPU chip under the
-driver); falls back to the jnp kernel automatically if Pallas is unavailable.
+The device section runs in a SUBPROCESS under a watchdog (default 900 s,
+override MBT_BENCH_TIMEOUT): the axon tunnel can wedge hard enough that
+device init hangs instead of erroring, and the harness must still emit its
+JSON line (falling back to the CPU number with the failure recorded) rather
+than hang the driver.
 """
 from __future__ import annotations
 
 import json
+import os
 import pathlib
+import subprocess
 import sys
 
-sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent))
+REPO = pathlib.Path(__file__).resolve().parent
+sys.path.insert(0, str(REPO))
+
+_DEVICE_CODE = """
+import json, sys
+import jax
+from mpi_blockchain_tpu.bench_lib import bench_chain, bench_tpu
+out = {"platform": jax.default_backend(),
+       "tpu": bench_tpu(seconds=8.0, batch_pow2=28, n_miners=1,
+                        kernel="auto")}
+# Second half of the metric: wall-clock to mine 1000 blocks at difficulty
+# 24 (real accelerator only -- the host-CPU fallback would take hours).
+# A chain failure is reported as such; it must not discard the sweep rate.
+if jax.default_backend() != "cpu":
+    try:
+        out["chain"] = bench_chain(n_blocks=1000, difficulty_bits=24)
+    except Exception as e:
+        out["chain_error"] = f"{type(e).__name__}: {e}"
+print("BENCH_JSON:" + json.dumps(out))
+"""
+
+
+def _run_device_section() -> dict | None:
+    """Runs the TPU sweep + chain bench in a watchdogged subprocess."""
+    timeout_s = float(os.environ.get("MBT_BENCH_TIMEOUT", "900"))
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _DEVICE_CODE], cwd=str(REPO),
+            capture_output=True, text=True, timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return {"error": f"device bench timed out after {timeout_s:.0f}s "
+                         "(device init hang?)"}
+    for line in reversed(proc.stdout.splitlines()):
+        if line.startswith("BENCH_JSON:"):
+            return json.loads(line[len("BENCH_JSON:"):])
+    return {"error": f"device bench failed rc={proc.returncode}: "
+                     f"{proc.stderr[-500:]}"}
 
 
 def main() -> int:
-    import jax
-
-    from mpi_blockchain_tpu.bench_lib import bench_chain, bench_cpu, bench_tpu
+    from mpi_blockchain_tpu.bench_lib import bench_cpu
 
     cpu = bench_cpu(seconds=2.0, n_miners=8)
-    try:
-        tpu = bench_tpu(seconds=8.0, batch_pow2=28, n_miners=1,
-                        kernel="auto")
+    dev = _run_device_section()
+
+    rounded_cpu = {k: round(v, 1) if isinstance(v, float) else v
+                   for k, v in cpu.items()}
+    if dev is not None and "tpu" in dev:
+        tpu = dev["tpu"]
         value = tpu["hashes_per_sec_per_chip"]
         vs = tpu["hashes_per_sec"] / cpu["hashes_per_sec"]
         detail = {"tpu": {k: round(v, 1) if isinstance(v, float) else v
                           for k, v in tpu.items()},
-                  "cpu_np8": {k: round(v, 1) if isinstance(v, float) else v
-                              for k, v in cpu.items()}}
-        # Second half of the metric: wall-clock to mine 1000 blocks at
-        # difficulty 24 (real accelerator only — the host-CPU fallback
-        # would take hours). CPU denominator is extrapolated from the
-        # measured rate: 1000 * 2^24 expected hashes. A chain failure is
-        # reported as such — it must not discard the measured sweep rate.
-        if jax.default_backend() != "cpu":
-            try:
-                chain = bench_chain(n_blocks=1000, difficulty_bits=24)
-                cpu_extrapolated_s = 1000 * (1 << 24) / cpu["hashes_per_sec"]
-                detail["chain_1000_diff24"] = {
-                    "wall_s": chain["wall_s"],
-                    "tip_hash": chain["tip_hash"],
-                    "vs_cpu_np8_extrapolated":
-                        round(cpu_extrapolated_s / chain["wall_s"], 1),
-                }
-            except Exception as e:
-                detail["chain_1000_diff24"] = {
-                    "error": f"{type(e).__name__}: {e}"}
-    except Exception as e:  # no usable device: report the CPU number
+                  "cpu_np8": rounded_cpu}
+        if "chain" in dev:
+            chain = dev["chain"]
+            cpu_extrapolated_s = 1000 * (1 << 24) / cpu["hashes_per_sec"]
+            detail["chain_1000_diff24"] = {
+                "wall_s": chain["wall_s"],
+                "tip_hash": chain["tip_hash"],
+                "vs_cpu_np8_extrapolated":
+                    round(cpu_extrapolated_s / chain["wall_s"], 1),
+            }
+        elif "chain_error" in dev:
+            detail["chain_1000_diff24"] = {"error": dev["chain_error"]}
+    else:  # no usable device: report the CPU number
         value = cpu["hashes_per_sec_per_rank"]
         vs = 1.0 / 8.0
-        detail = {"error": f"tpu bench failed: {type(e).__name__}: {e}",
-                  "cpu_np8": cpu}
+        detail = {"error": "tpu bench failed: "
+                           + (dev or {}).get("error", "unknown"),
+                  "cpu_np8": rounded_cpu}
     print(json.dumps({
         "metric": "hashes_per_sec_per_chip",
         "value": round(value),
