@@ -182,7 +182,7 @@ def cmd_sim(args) -> int:
                               nonce_budget=1 << args.nonce_budget_pow2,
                               delay_steps=args.delay_steps,
                               drop_rate_pct=args.drop_rate,
-                              seed=args.seed)
+                              seed=args.seed, n_groups=args.groups)
     except RuntimeError as e:  # Network.run: no convergence in max_steps
         print(json.dumps({"event": "sim_done", "converged": False,
                           "error": str(e)}, sort_keys=True))
@@ -316,6 +316,8 @@ def main(argv: list[str] | None = None) -> int:
                        help="%% of deliveries dropped (seeded, deterministic)")
     p_sim.add_argument("--seed", type=int, default=0,
                        help="seed for the drop schedule")
+    p_sim.add_argument("--groups", type=int, default=2,
+                       help="number of competing miner groups")
     p_sim.set_defaults(fn=cmd_sim)
 
     p_info = sub.add_parser("info", help="world/topology introspection "

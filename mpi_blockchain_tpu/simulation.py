@@ -215,17 +215,20 @@ def seeded_drop(drop_rate_pct: int, seed: int = 0
 def run_adversarial(config: MinerConfig | None = None,
                     partition_steps: int = 30, target_height: int = 8,
                     nonce_budget: int = 1 << 8, delay_steps: int = 1,
-                    drop_rate_pct: int = 0, seed: int = 0) -> Network:
-    """BASELINE config 5: two competing miner groups, then reconciliation.
+                    drop_rate_pct: int = 0, seed: int = 0,
+                    n_groups: int = 2) -> Network:
+    """BASELINE config 5: competing miner groups, then reconciliation.
 
-    Two groups mine in a partition (building competing chains with different
-    payloads), the partition heals, and longest-chain reorg resolution must
-    converge every node onto one chain — optionally under delivery delay
-    and seeded random message loss on top of the partition.
+    n_groups groups mine in a partition (building competing chains with
+    different payloads), the partition heals, and longest-chain reorg
+    resolution must converge every node onto one chain — optionally under
+    delivery delay and seeded random message loss on top of the partition.
     """
+    if n_groups < 2:
+        raise ValueError(f"n_groups must be >= 2, got {n_groups}")
     cfg = config if config is not None else MinerConfig(
         difficulty_bits=8, n_blocks=target_height, backend="cpu")
-    nodes = [SimNode(0, cfg), SimNode(1, cfg)]
+    nodes = [SimNode(i, cfg) for i in range(n_groups)]
     net = Network(nodes, delay_steps=delay_steps,
                   drop_fn=(seeded_drop(drop_rate_pct, seed)
                            if drop_rate_pct else None),

@@ -112,3 +112,10 @@ def test_seeded_drop_faults_converge_deterministically():
     assert [n.node.tip_hash for n in n1.nodes] == \
            [n.node.tip_hash for n in n2.nodes]
     assert n1.step_count == n2.step_count
+
+
+def test_three_group_partition_converges():
+    net = run_adversarial(partition_steps=15, target_height=4, n_groups=3)
+    assert net.converged()
+    assert len(net.nodes) == 3
+    assert all(n.node.height >= 4 for n in net.nodes)
