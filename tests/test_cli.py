@@ -168,3 +168,11 @@ def test_cli_oversubscribed_mesh_clean_error(capsys):
                "tpu", "--kernel", "jnp", "--miners", "9"])
     out = json.loads(capsys.readouterr().out)
     assert rc == 2 and "9 devices" in out["error"]
+
+
+def test_cli_bench_chain_sharded(capsys):
+    rc = main(["bench", "--mode", "chain", "--blocks", "2", "--difficulty",
+               "6", "--batch-pow2", "11", "--blocks-per-call", "2",
+               "--miners", "8", "--kernel", "jnp"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0 and out["n_miners"] == 8 and out["n_blocks"] == 2
