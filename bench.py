@@ -41,7 +41,12 @@ print("BENCH_JSON:" + json.dumps(out))
 """
 
 
-def _run_device_section() -> dict | None:
+def _round_floats(d: dict) -> dict:
+    return {k: round(v, 1) if isinstance(v, float) else v
+            for k, v in d.items()}
+
+
+def _run_device_section() -> dict:
     """Runs the TPU sweep + chain bench in a watchdogged subprocess."""
     timeout_s = float(os.environ.get("MBT_BENCH_TIMEOUT", "900"))
     try:
@@ -64,15 +69,11 @@ def main() -> int:
     cpu = bench_cpu(seconds=2.0, n_miners=8)
     dev = _run_device_section()
 
-    rounded_cpu = {k: round(v, 1) if isinstance(v, float) else v
-                   for k, v in cpu.items()}
-    if dev is not None and "tpu" in dev:
+    if "tpu" in dev:
         tpu = dev["tpu"]
         value = tpu["hashes_per_sec_per_chip"]
         vs = tpu["hashes_per_sec"] / cpu["hashes_per_sec"]
-        detail = {"tpu": {k: round(v, 1) if isinstance(v, float) else v
-                          for k, v in tpu.items()},
-                  "cpu_np8": rounded_cpu}
+        detail = {"tpu": _round_floats(tpu), "cpu_np8": _round_floats(cpu)}
         if "chain" in dev:
             chain = dev["chain"]
             cpu_extrapolated_s = 1000 * (1 << 24) / cpu["hashes_per_sec"]
@@ -88,8 +89,8 @@ def main() -> int:
         value = cpu["hashes_per_sec_per_rank"]
         vs = 1.0 / 8.0
         detail = {"error": "tpu bench failed: "
-                           + (dev or {}).get("error", "unknown"),
-                  "cpu_np8": rounded_cpu}
+                           + dev.get("error", "unknown"),
+                  "cpu_np8": _round_floats(cpu)}
     print(json.dumps({
         "metric": "hashes_per_sec_per_chip",
         "value": round(value),

@@ -42,6 +42,53 @@ def _config_from(args) -> MinerConfig:
                        backend=args.backend, kernel=args.kernel)
 
 
+def _init_world(args, cfg):
+    """Joins the multi-process world when --coordinator is given.
+
+    The reference's `mpirun -np N` across hosts: every process runs this
+    same program over one global ('miners',) mesh; XLA routes winner-select
+    over ICI/DCN. Returns (cfg, mesh, is_main).
+    """
+    if not args.coordinator:
+        return cfg, None, True
+    import jax
+
+    from .parallel.distributed import init_distributed, make_global_miner_mesh
+    init_distributed(args.coordinator, args.num_processes, args.process_id)
+    mesh = make_global_miner_mesh()
+    cfg = dataclasses.replace(cfg, backend="tpu",
+                              n_miners=len(jax.devices()))
+    return cfg, mesh, jax.process_index() == 0
+
+
+def _load_resume(path: str, cfg, mesh):
+    """Loads the --resume checkpoint. Returns (node, error_or_None)."""
+    from .utils.checkpoint import load_chain
+
+    node, err = None, None
+    try:
+        node = load_chain(path, cfg.difficulty_bits)
+    except (OSError, ValueError) as e:
+        err = str(e)
+    if mesh is not None:
+        # Every process must resume from the SAME chain state, or they
+        # issue different numbers of collective mine rounds and the world
+        # deadlocks. Agree before the first device call; abort everywhere
+        # on any failure or divergence.
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        tip = node.tip_hash[:8] if node is not None else b"\0" * 8
+        state = np.array([err is None,
+                          node.height if node is not None else -1,
+                          *tip], dtype=np.int64)
+        rows = multihost_utils.process_allgather(state)
+        if not (rows == rows[0]).all():
+            err = (f"resume state diverges across processes "
+                   f"(this process: {err or 'ok'})")
+    return node, err
+
+
 def cmd_mine(args) -> int:
     import contextlib
 
@@ -51,22 +98,7 @@ def cmd_mine(args) -> int:
     cfg = _config_from(args)
     if args.verbose:
         get_logger().setLevel("DEBUG")
-    mesh = None
-    is_main = True
-    if args.coordinator:
-        # Multi-process launch — the reference's `mpirun -np N` across
-        # hosts. Every process runs this same program over one global
-        # ('miners',) mesh; XLA routes winner-select over ICI/DCN.
-        import jax
-
-        from .parallel.distributed import (init_distributed,
-                                           make_global_miner_mesh)
-        init_distributed(args.coordinator, args.num_processes,
-                         args.process_id)
-        mesh = make_global_miner_mesh()
-        cfg = dataclasses.replace(cfg, backend="tpu",
-                                  n_miners=len(jax.devices()))
-        is_main = jax.process_index() == 0
+    cfg, mesh, is_main = _init_world(args, cfg)
     if args.fused:
         from .models.fused import FusedMiner
         miner = FusedMiner(cfg, blocks_per_call=args.blocks_per_call,
@@ -79,28 +111,7 @@ def cmd_mine(args) -> int:
     else:
         miner = Miner(cfg)
     if args.resume:
-        from .utils.checkpoint import load_chain
-        node, err = None, None
-        try:
-            node = load_chain(args.resume, cfg.difficulty_bits)
-        except (OSError, ValueError) as e:
-            err = str(e)
-        if mesh is not None:
-            # Every process must resume from the SAME chain state, or they
-            # issue different numbers of collective mine rounds and the
-            # world deadlocks. Agree before the first device call; abort
-            # everywhere on any failure or divergence.
-            import numpy as np
-            from jax.experimental import multihost_utils
-
-            tip = node.tip_hash[:8] if node is not None else b"\0" * 8
-            state = np.array([err is None,
-                              node.height if node is not None else -1,
-                              *tip], dtype=np.int64)
-            rows = multihost_utils.process_allgather(state)
-            if not (rows == rows[0]).all():
-                err = (f"resume state diverges across processes "
-                       f"(this process: {err or 'ok'})")
+        node, err = _load_resume(args.resume, cfg, mesh)
         if err is not None:
             print(json.dumps({"event": "chain_mined", "error": err},
                              sort_keys=True))
