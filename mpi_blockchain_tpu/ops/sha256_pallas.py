@@ -27,6 +27,7 @@ see bench.py — or stay device-resident (models/fused.py).
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -40,6 +41,9 @@ _U32 = jnp.uint32
 _LANES = 128
 _ROWS = 64                      # 64*128 = 8192 nonces per grid program
 TILE = _ROWS * _LANES
+
+# Early-exit kernel implementation: "grid" or "while" (see pallas_sweep_core).
+EARLY_EXIT_IMPL = os.environ.get("MBT_EARLY_EXIT_IMPL", "grid")
 
 
 def _rotr(x, n: int):
@@ -94,12 +98,65 @@ def _compress_unrolled(state, w):
         return tuple(o + s for o, s in zip(out, state))
 
 
+def _tile_result(midstate_ref, tail_ref, base, *, difficulty_bits: int):
+    """(count, biased_min) for the 8192-nonce tile starting at base.
+
+    Uniform words stay SCALAR (SMEM values / numpy constants) — only the
+    nonce word is a vector. jnp promotion then keeps every all-uniform
+    intermediate on the scalar core: rounds 0-2 of hash 1 (the nonce enters
+    at round 3), the uniform terms of the message schedule, and hash 2's
+    constant padding words cost no VPU work, and numpy folds the
+    all-constant parts at trace time.
+    """
+    row = jax.lax.broadcasted_iota(_U32, (_ROWS, _LANES), 0)
+    lane = jax.lax.broadcasted_iota(_U32, (_ROWS, _LANES), 1)
+    nonces = base + row * np.uint32(_LANES) + lane
+
+    # Chunk 2 of the first hash: uniform words from SMEM, nonce in word 3.
+    w1 = [tail_ref[i] if i != 3 else _bswap32(nonces) for i in range(16)]
+    st1 = tuple(midstate_ref[i] for i in range(8))
+    d1 = _compress_unrolled(st1, w1)
+    # Second hash: one padded chunk whose first 8 words are digest 1.
+    w2 = list(d1) + [np.uint32(0x80000000)] \
+        + [np.uint32(0)] * 6 + [np.uint32(256)]
+    st2 = tuple(np.uint32(v) for v in IV)
+    d2 = _compress_unrolled(st2, w2)
+
+    # Leading-zero-bits difficulty check on the big-endian digest.
+    h0, h1 = d2[0], d2[1]
+    dbits = int(difficulty_bits)
+    if dbits <= 0:
+        qual = jnp.ones_like(h0, dtype=jnp.bool_)
+    elif dbits < 32:
+        qual = h0 < np.uint32(1 << (32 - dbits))
+    elif dbits == 32:
+        qual = h0 == np.uint32(0)
+    elif dbits < 64:
+        qual = (h0 == np.uint32(0)) & (h1 < np.uint32(1 << (64 - dbits)))
+    else:
+        qual = (h0 == np.uint32(0)) & (h1 == np.uint32(0))
+
+    # Mosaic has no unsigned reductions, so the min runs on bias-flipped
+    # int32 (x ^ 0x80000000 is order-isomorphic uint32 -> int32); the
+    # caller unbiases. The 0xFFFFFFFF sentinel biases to int32 max — the
+    # identity.
+    count = jnp.sum(qual.astype(jnp.int32))
+    biased = jax.lax.bitcast_convert_type(
+        jnp.where(qual, nonces, NOT_FOUND_U32) ^ np.uint32(0x80000000),
+        jnp.int32)
+    return count, jnp.min(biased)
+
+
 def _sweep_kernel(midstate_ref, tail_ref, base_ref, count_ref, min_ref, *,
                   difficulty_bits: int, early_exit: bool):
+    """Grid sweep: one tile per program, sequential on the core.
+
+    Programs accumulate into one (1,1) SMEM cell: initialize at program 0,
+    then reduce. With early_exit, tiles after the first qualifying one skip
+    their hash work (tiles are ascending, so min_nonce cannot change).
+    """
     pid = pl.program_id(0)
 
-    # The TPU grid runs sequentially on a core, so programs accumulate into
-    # one (1,1) SMEM cell: initialize at program 0, then reduce.
     @pl.when(pid == 0)
     def _():
         count_ref[0, 0] = jnp.int32(0)
@@ -107,63 +164,46 @@ def _sweep_kernel(midstate_ref, tail_ref, base_ref, count_ref, min_ref, *,
 
     def tile():
         base = base_ref[0] + (pid * np.uint32(TILE)).astype(_U32)
-        row = jax.lax.broadcasted_iota(_U32, (_ROWS, _LANES), 0)
-        lane = jax.lax.broadcasted_iota(_U32, (_ROWS, _LANES), 1)
-        nonces = base + row * np.uint32(_LANES) + lane
-
-        # Uniform words stay SCALAR (SMEM values / numpy constants) — only
-        # the nonce word is a vector. jnp promotion then keeps every
-        # all-uniform intermediate on the scalar core: rounds 0-2 of hash 1
-        # (the nonce enters at round 3), the uniform terms of the message
-        # schedule, and hash 2's constant padding words cost no VPU work,
-        # and numpy folds the all-constant parts at trace time.
-        w1 = [tail_ref[i] if i != 3 else _bswap32(nonces)
-              for i in range(16)]
-        st1 = tuple(midstate_ref[i] for i in range(8))
-        d1 = _compress_unrolled(st1, w1)
-        # Second hash: one padded chunk whose first 8 words are digest 1.
-        w2 = list(d1) + [np.uint32(0x80000000)] \
-            + [np.uint32(0)] * 6 + [np.uint32(256)]
-        st2 = tuple(np.uint32(v) for v in IV)
-        d2 = _compress_unrolled(st2, w2)
-
-        # Leading-zero-bits difficulty check on the big-endian digest.
-        h0, h1 = d2[0], d2[1]
-        dbits = int(difficulty_bits)
-        if dbits <= 0:
-            qual = jnp.ones_like(h0, dtype=jnp.bool_)
-        elif dbits < 32:
-            qual = h0 < np.uint32(1 << (32 - dbits))
-        elif dbits == 32:
-            qual = h0 == np.uint32(0)
-        elif dbits < 64:
-            qual = (h0 == np.uint32(0)) & (h1 < np.uint32(1 << (64 - dbits)))
-        else:
-            qual = (h0 == np.uint32(0)) & (h1 == np.uint32(0))
-
-        # Mosaic has no unsigned reductions, so the min runs on bias-flipped
-        # int32 (x ^ 0x80000000 is order-isomorphic uint32 -> int32); the
-        # caller unbiases. The 0xFFFFFFFF sentinel biases to int32 max — the
-        # identity.
-        count_ref[0, 0] += jnp.sum(qual.astype(jnp.int32))
-        biased = jax.lax.bitcast_convert_type(
-            jnp.where(qual, nonces, NOT_FOUND_U32) ^ np.uint32(0x80000000),
-            jnp.int32)
-        min_ref[0, 0] = jnp.minimum(min_ref[0, 0], jnp.min(biased))
+        c, m = _tile_result(midstate_ref, tail_ref, base,
+                            difficulty_bits=difficulty_bits)
+        count_ref[0, 0] += c
+        min_ref[0, 0] = jnp.minimum(min_ref[0, 0], m)
 
     if early_exit:
-        # Tiles sweep ascending nonce ranges and the grid is sequential, so
-        # once any tile has recorded a qualifier every later tile holds only
-        # larger nonces — skipping their hash work cannot change min_nonce.
-        # count then means "qualifiers up to and including the first
-        # qualifying tile" (>0 iff the batch prefix contains a winner),
-        # which is all the mine loop consumes. Exact-count callers (the
-        # sweep API, the bench) keep early_exit=False.
         @pl.when(count_ref[0, 0] == 0)
         def _():
             tile()
     else:
         tile()
+
+
+def _mine_kernel(midstate_ref, tail_ref, base_ref, count_ref, min_ref, *,
+                 difficulty_bits: int, n_tiles: int):
+    """Early-exit sweep as ONE program: a while_loop over ascending tiles
+    that stops at the first tile containing a qualifier.
+
+    Versus the sequential-grid variant with per-program skip predicates,
+    the not-taken tiles cost nothing at all (the loop just exits) — at
+    mining batch sizes that is ~1 ms/block of skipped-tile overhead gone.
+    min_nonce is exact (ascending order); count is exact through the first
+    qualifying tile, i.e. a found-flag — the mine-loop contract.
+    """
+    def cond(s):
+        t, c, _ = s
+        return (c == 0) & (t < n_tiles)
+
+    def body(s):
+        t, _, _ = s
+        base = base_ref[0] + t.astype(_U32) * np.uint32(TILE)
+        c, m = _tile_result(midstate_ref, tail_ref, base,
+                            difficulty_bits=difficulty_bits)
+        return t + np.int32(1), c, m
+
+    _, c, m = jax.lax.while_loop(
+        cond, body,
+        (jnp.int32(0), jnp.int32(0), jnp.int32(0x7FFFFFFF)))
+    count_ref[0, 0] = c
+    min_ref[0, 0] = m
 
 
 def pallas_sweep_core(midstate, tail_w, base_nonce, *, batch_size: int,
@@ -181,9 +221,23 @@ def pallas_sweep_core(midstate, tail_w, base_nonce, *, batch_size: int,
         raise ValueError(f"batch_size {batch_size} not a multiple of {TILE}")
     n_tiles = batch_size // TILE
 
+    # Early-exit implementations: "grid" (per-program skip predicate,
+    # hardware-validated) vs "while" (single program, lax.while_loop over
+    # tiles — skipped tiles cost nothing, ~1 ms/block less overhead, but
+    # NOT yet validated on hardware: flip the default once it is).
+    if early_exit and EARLY_EXIT_IMPL == "while":
+        kernel = functools.partial(_mine_kernel,
+                                   difficulty_bits=difficulty_bits,
+                                   n_tiles=n_tiles)
+        grid = (1,)    # ONE program; the tile loop lives inside the kernel
+    else:
+        kernel = functools.partial(_sweep_kernel,
+                                   difficulty_bits=difficulty_bits,
+                                   early_exit=early_exit)
+        grid = (n_tiles,)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,      # midstate, tail, base — all SMEM scalars
-        grid=(n_tiles,),
+        grid=grid,
         in_specs=[],
         out_specs=[
             pl.BlockSpec((1, 1), lambda i, *_: (0, 0),
@@ -193,8 +247,7 @@ def pallas_sweep_core(midstate, tail_w, base_nonce, *, batch_size: int,
         ],
     )
     count, min_biased = pl.pallas_call(
-        functools.partial(_sweep_kernel, difficulty_bits=difficulty_bits,
-                          early_exit=early_exit),
+        kernel,
         out_shape=[jax.ShapeDtypeStruct((1, 1), jnp.int32),
                    jax.ShapeDtypeStruct((1, 1), jnp.int32)],
         grid_spec=grid_spec,
