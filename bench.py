@@ -5,39 +5,98 @@ TPU total rate / 8-rank CPU total rate (the mpirun -np 8 stand-in: 8 C++
 threads running the scalar miner loop with the GIL released — OpenMPI is not
 in this image; documented in BASELINE.md).
 
-The device section runs in a SUBPROCESS under a watchdog (default 900 s,
-override MBT_BENCH_TIMEOUT): the axon tunnel can wedge hard enough that
-device init hangs instead of erroring, and the harness must still emit its
-JSON line (falling back to the CPU number with the failure recorded) rather
-than hang the driver.
+Round-1 postmortem baked in: the axon tunnel can wedge at device init, and a
+single end-of-run print lost every device number when the watchdog fired
+(BENCH_r01.json recorded the CPU fallback despite a measured 971.8 MH/s).
+The harness is now hang-proof and evidence-preserving:
+
+* the device subprocess emits an incremental ``BENCH_JSON`` line per section
+  (platform, sweep, chain) the moment each is measured; the parent streams
+  them, so a hang later in the run cannot discard an earlier measurement;
+* device init is probed by a short subprocess first (default 120 s,
+  ``MBT_BENCH_PROBE_TIMEOUT``); on failure, stale chip-holding processes are
+  killed (the tunnel is effectively single-client) and the probe retried once;
+* every successful device measurement is persisted to ``BENCH_CACHE.json``
+  with a UTC timestamp; on device failure the last-good numbers are reported,
+  clearly labeled ``{"cached": true, "measured_at": ...}`` alongside the
+  failure — a wedged tunnel can no longer zero out the round;
+* a sharded-chain determinism stanza (fused miner on an 8-device virtual CPU
+  mesh vs the C++ oracle, identical tips) runs every round — BASELINE
+  config 4's determinism, pinned as a per-round regression record.
 """
 from __future__ import annotations
 
+import datetime
 import json
 import os
 import pathlib
+import signal
 import subprocess
 import sys
+import threading
+import time
 
 REPO = pathlib.Path(__file__).resolve().parent
 sys.path.insert(0, str(REPO))
 
+CACHE_PATH = REPO / "BENCH_CACHE.json"
+
+# Marker string present in every device-child cmdline so a stale-process
+# sweep can find leftovers from earlier runs: MBT_BENCH_SECTION.
 _DEVICE_CODE = """
-import json, sys
+# MBT_BENCH_SECTION device child
+import json
+def emit(section, payload):
+    print("BENCH_JSON:" + json.dumps({"section": section,
+                                      "payload": payload}), flush=True)
 import jax
 from mpi_blockchain_tpu.bench_lib import bench_chain, bench_tpu
-out = {"platform": jax.default_backend(),
-       "tpu": bench_tpu(seconds=8.0, batch_pow2=28, n_miners=1,
-                        kernel="auto")}
+emit("platform", jax.default_backend())
+emit("sweep", bench_tpu(seconds=8.0, batch_pow2=28, n_miners=1,
+                        kernel="auto"))
 # Second half of the metric: wall-clock to mine 1000 blocks at difficulty
 # 24 (real accelerator only -- the host-CPU fallback would take hours).
-# A chain failure is reported as such; it must not discard the sweep rate.
 if jax.default_backend() != "cpu":
     try:
-        out["chain"] = bench_chain(n_blocks=1000, difficulty_bits=24)
+        emit("chain", bench_chain(n_blocks=1000, difficulty_bits=24))
     except Exception as e:
-        out["chain_error"] = f"{type(e).__name__}: {e}"
-print("BENCH_JSON:" + json.dumps(out))
+        emit("chain_error", f"{type(e).__name__}: {e}")
+"""
+
+_PROBE_CODE = """
+# MBT_BENCH_SECTION probe child
+import json, jax
+print("BENCH_JSON:" + json.dumps({"section": "platform",
+                                  "payload": jax.default_backend()}),
+      flush=True)
+"""
+
+# Config 4's determinism as a per-round record: the fused sharded miner on a
+# virtual 8-device CPU mesh must produce byte-identical blocks to the C++
+# scalar oracle (lowest-qualifying-nonce winner rule makes this exact).
+_SHARDED_CODE = """
+# MBT_BENCH_SECTION sharded child
+import json
+import jax
+jax.config.update("jax_platforms", "cpu")  # beats the axon site-hook
+from mpi_blockchain_tpu.config import MinerConfig
+from mpi_blockchain_tpu.models.fused import FusedMiner
+from mpi_blockchain_tpu.models.miner import Miner
+D, N = 8, 3
+fused = FusedMiner(MinerConfig(difficulty_bits=D, n_blocks=N, batch_pow2=11,
+                               n_miners=8, backend="tpu", kernel="jnp"),
+                   blocks_per_call=N)
+fused.mine_chain()
+oracle = Miner(MinerConfig(difficulty_bits=D, n_blocks=N, backend="cpu"),
+               log_fn=lambda d: None)
+oracle.mine_chain()
+mesh_tip = fused.node.tip_hash.hex()
+cpu_tip = oracle.node.tip_hash.hex()
+print("BENCH_JSON:" + json.dumps({"section": "sharded_chain", "payload": {
+    "n_miners": 8, "n_blocks": N, "difficulty_bits": D,
+    "mesh": "virtual-cpu-8", "tip_hash": mesh_tip,
+    "cpu_oracle_tip": cpu_tip,
+    "tip_matches_cpu_oracle": mesh_tip == cpu_tip}}), flush=True)
 """
 
 
@@ -46,56 +105,231 @@ def _round_floats(d: dict) -> dict:
             for k, v in d.items()}
 
 
-def _run_device_section() -> dict:
-    """Runs the TPU sweep + chain bench in a watchdogged subprocess."""
-    timeout_s = float(os.environ.get("MBT_BENCH_TIMEOUT", "900"))
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-c", _DEVICE_CODE], cwd=str(REPO),
-            capture_output=True, text=True, timeout=timeout_s)
-    except subprocess.TimeoutExpired:
-        return {"error": f"device bench timed out after {timeout_s:.0f}s "
-                         "(device init hang?)"}
-    for line in reversed(proc.stdout.splitlines()):
-        if line.startswith("BENCH_JSON:"):
-            return json.loads(line[len("BENCH_JSON:"):])
-    return {"error": f"device bench failed rc={proc.returncode}: "
-                     f"{proc.stderr[-500:]}"}
+def _utc_now() -> str:
+    return datetime.datetime.now(datetime.timezone.utc).strftime(
+        "%Y-%m-%dT%H:%M:%SZ")
 
+
+# ---- streaming child runner -------------------------------------------------
+
+def _stream_child(code: str, timeout_s: float,
+                  env: dict | None = None) -> tuple[dict, str | None]:
+    """Runs `code` in a subprocess, collecting BENCH_JSON section lines as
+    they are printed. Returns (sections, error): sections survive even if
+    the child later hangs or dies — that is the whole point."""
+    proc = subprocess.Popen([sys.executable, "-c", code], cwd=str(REPO),
+                            env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True)
+    sections: dict = {}
+    err_tail: list[str] = []
+
+    def _read_out():
+        for line in proc.stdout:
+            if line.startswith("BENCH_JSON:"):
+                try:
+                    d = json.loads(line[len("BENCH_JSON:"):])
+                    sections[d["section"]] = d["payload"]
+                except (json.JSONDecodeError, KeyError):
+                    pass
+
+    def _read_err():
+        for line in proc.stderr:
+            err_tail.append(line)
+            del err_tail[:-40]
+
+    t_out = threading.Thread(target=_read_out, daemon=True)
+    t_err = threading.Thread(target=_read_err, daemon=True)
+    t_out.start()
+    t_err.start()
+    error = None
+    try:
+        rc = proc.wait(timeout=timeout_s)
+        t_out.join(timeout=10)
+        t_err.join(timeout=10)
+        if rc != 0:
+            error = (f"child exited rc={rc}: "
+                     f"{''.join(err_tail)[-500:]}")
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.wait()
+        error = (f"child timed out after {timeout_s:.0f}s; "
+                 f"stderr tail: {''.join(err_tail)[-500:]}")
+    return sections, error
+
+
+# ---- stale chip-holder sweep ------------------------------------------------
+
+def _proc_age_s(pid: int) -> float | None:
+    """Seconds since the process started, via /proc (None if unreadable)."""
+    try:
+        stat = pathlib.Path(f"/proc/{pid}/stat").read_text()
+        start_ticks = int(stat.rsplit(")", 1)[1].split()[19])
+        uptime_s = float(pathlib.Path("/proc/uptime").read_text().split()[0])
+        hz = os.sysconf("SC_CLK_TCK")
+        return uptime_s - start_ticks / hz
+    except (OSError, ValueError, IndexError):
+        return None
+
+
+def _kill_stale_chip_holders(min_age_s: float = 1800.0) -> list[int]:
+    """The axon tunnel is effectively single-client: a leftover device
+    process from an earlier run makes fresh init hang (round 1's failure
+    mode). Kill python processes that carry our cmdline markers — but only
+    genuinely STALE ones (orphaned, or older than min_age_s), never
+    ourselves/our ancestors, and never a healthy concurrent run someone
+    just started."""
+    me = os.getpid()
+    ancestors = {me}
+    pid = me
+    while pid > 1:
+        try:
+            pid = int(pathlib.Path(f"/proc/{pid}/stat")
+                      .read_text().rsplit(")", 1)[1].split()[1])
+            ancestors.add(pid)
+        except (OSError, ValueError, IndexError):
+            break
+    markers = ("MBT_BENCH_SECTION", "mpi_blockchain_tpu", "__graft_entry__")
+    victims = []
+    for p in pathlib.Path("/proc").iterdir():
+        if not p.name.isdigit() or int(p.name) in ancestors:
+            continue
+        try:
+            cmd = (p / "cmdline").read_bytes().replace(b"\0", b" ").decode()
+            ppid = int((p / "stat").read_text()
+                       .rsplit(")", 1)[1].split()[1])
+        except (OSError, ValueError, IndexError):
+            continue
+        if "python" not in cmd or not any(m in cmd for m in markers):
+            continue
+        age = _proc_age_s(int(p.name))
+        if ppid == 1 or (age is not None and age > min_age_s):
+            victims.append(int(p.name))
+    for pid in victims:
+        try:
+            os.kill(pid, signal.SIGTERM)
+        except (ProcessLookupError, PermissionError):
+            pass
+    if victims:
+        time.sleep(1.0)
+        for pid in victims:
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+    return victims
+
+
+# ---- cache ------------------------------------------------------------------
+
+def _load_cache() -> dict:
+    try:
+        return json.loads(CACHE_PATH.read_text())
+    except (OSError, json.JSONDecodeError):
+        return {}
+
+
+def _cache_store(section: str, payload) -> None:
+    cache = _load_cache()
+    cache[section] = {"payload": payload, "measured_at": _utc_now()}
+    tmp = CACHE_PATH.with_suffix(".tmp")
+    tmp.write_text(json.dumps(cache, indent=1, sort_keys=True))
+    tmp.replace(CACHE_PATH)
+
+
+def _cached(section: str) -> dict | None:
+    ent = _load_cache().get(section)
+    if not ent:
+        return None
+    return {**ent["payload"], "cached": True,
+            "measured_at": ent["measured_at"]}
+
+
+# ---- sections ---------------------------------------------------------------
+
+def _run_device_section() -> tuple[dict, str | None]:
+    """Probe init briefly (retry once after a stale sweep), then stream the
+    full sweep+chain bench under the long watchdog."""
+    probe_s = float(os.environ.get("MBT_BENCH_PROBE_TIMEOUT", "120"))
+    timeout_s = float(os.environ.get("MBT_BENCH_TIMEOUT", "900"))
+    probe, err = _stream_child(_PROBE_CODE, probe_s)
+    if "platform" not in probe:
+        killed = _kill_stale_chip_holders()
+        probe, err = _stream_child(_PROBE_CODE, probe_s)
+        if "platform" not in probe:
+            return {}, (f"device init probe failed twice "
+                        f"(killed stale pids {killed}): {err}")
+    return _stream_child(_DEVICE_CODE, timeout_s)
+
+
+def _run_sharded_section() -> tuple[dict, str | None]:
+    from mpi_blockchain_tpu.utils.platform_env import force_cpu_mesh_env
+    return _stream_child(_SHARDED_CODE, timeout_s=300,
+                         env=force_cpu_mesh_env(os.environ, 8))
+
+
+# ---- assembly ---------------------------------------------------------------
 
 def main() -> int:
     from mpi_blockchain_tpu.bench_lib import bench_cpu
 
     cpu = bench_cpu(seconds=2.0, n_miners=8)
-    dev = _run_device_section()
+    sharded, sharded_err = _run_sharded_section()
+    dev, dev_err = _run_device_section()
 
-    if "tpu" in dev:
-        tpu = dev["tpu"]
-        value = tpu["hashes_per_sec_per_chip"]
-        vs = tpu["hashes_per_sec"] / cpu["hashes_per_sec"]
-        detail = {"tpu": _round_floats(tpu), "cpu_np8": _round_floats(cpu)}
-        if "chain" in dev:
-            chain = dev["chain"]
-            cpu_extrapolated_s = 1000 * (1 << 24) / cpu["hashes_per_sec"]
-            detail["chain_1000_diff24"] = {
-                "wall_s": chain["wall_s"],
-                "tip_hash": chain["tip_hash"],
-                "vs_cpu_np8_extrapolated":
-                    round(cpu_extrapolated_s / chain["wall_s"], 1),
-            }
-        elif "chain_error" in dev:
-            detail["chain_1000_diff24"] = {"error": dev["chain_error"]}
-    else:  # no usable device: report the CPU number
+    detail: dict = {"cpu_np8": _round_floats(cpu)}
+    if dev_err:
+        detail["device_error"] = dev_err
+
+    if "sharded_chain" in sharded:
+        detail["sharded_chain"] = sharded["sharded_chain"]
+        _cache_store("sharded_chain", sharded["sharded_chain"])
+    else:
+        detail["sharded_chain"] = {"error": sharded_err or "no output"}
+
+    # Sweep: prefer a fresh on-device measurement; fall back to last-good
+    # cache (honestly labeled); only then to the CPU number.
+    sweep = dev.get("sweep")
+    if sweep is not None and dev.get("platform") != "cpu":
+        _cache_store("sweep", sweep)
+        source = "fresh"
+    else:
+        if sweep is not None:  # device child fell back to host CPU platform
+            detail["device_error"] = (detail.get("device_error", "")
+                                      + " [device child ran on cpu platform]")
+        sweep = _cached("sweep")
+        source = "cache" if sweep else "cpu-fallback"
+
+    chain = dev.get("chain")
+    if chain is not None:
+        _cache_store("chain", chain)
+    elif "chain_error" in dev:
+        detail["chain_1000_diff24"] = {"error": dev["chain_error"]}
+    else:
+        chain = _cached("chain")
+    if chain is not None and "wall_s" in chain:
+        cpu_extrapolated_s = 1000 * (1 << 24) / cpu["hashes_per_sec"]
+        detail["chain_1000_diff24"] = {
+            k: chain[k] for k in ("wall_s", "tip_hash") if k in chain}
+        detail["chain_1000_diff24"]["vs_cpu_np8_extrapolated"] = round(
+            cpu_extrapolated_s / chain["wall_s"], 1)
+        if chain.get("cached"):
+            detail["chain_1000_diff24"]["cached"] = True
+            detail["chain_1000_diff24"]["measured_at"] = chain["measured_at"]
+
+    if source in ("fresh", "cache"):
+        value = sweep["hashes_per_sec_per_chip"]
+        vs = sweep["hashes_per_sec"] / cpu["hashes_per_sec"]
+        detail["tpu"] = _round_floats(sweep)
+    else:
         value = cpu["hashes_per_sec_per_rank"]
         vs = 1.0 / 8.0
-        detail = {"error": "tpu bench failed: "
-                           + dev.get("error", "unknown"),
-                  "cpu_np8": _round_floats(cpu)}
+
     print(json.dumps({
         "metric": "hashes_per_sec_per_chip",
         "value": round(value),
         "unit": "hashes/s/chip",
         "vs_baseline": round(vs, 3),
+        "source": source,
         "detail": detail,
     }, sort_keys=True))
     return 0

@@ -11,12 +11,12 @@ import sys
 # only way to execute tests/test_pallas.py, which module-skips off-TPU).
 _PLATFORM = os.environ.get("MBT_TEST_PLATFORM", "cpu")
 
-if _PLATFORM == "cpu":
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
-                               + " --xla_force_host_platform_device_count=8")
-
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if _PLATFORM == "cpu":
+    # Shared recipe (jax-free import; see utils/platform_env.py).
+    from mpi_blockchain_tpu.utils.platform_env import force_cpu_mesh_env
+    os.environ.update(force_cpu_mesh_env(os.environ, 8))
 
 # The axon TPU site-hook re-forces JAX_PLATFORMS=axon after env setup; the
 # config knob wins over it, so set it explicitly as well.
