@@ -57,6 +57,12 @@ Block Block::from_header(const BlockHeader& h, uint64_t height) {
   return b;
 }
 
+namespace {
+inline std::string hash_key(const uint8_t hash[32]) {
+  return std::string(reinterpret_cast<const char*>(hash), 32);
+}
+}  // namespace
+
 Chain::Chain(uint32_t difficulty_bits) : difficulty_bits_(difficulty_bits) {
   BlockHeader genesis;
   genesis.version = kVersion;
@@ -68,6 +74,14 @@ Chain::Chain(uint32_t difficulty_bits) : difficulty_bits_(difficulty_bits) {
   genesis.bits = difficulty_bits;
   genesis.nonce = 0;
   blocks_.push_back(Block::from_header(genesis, 0));
+  index_add(blocks_.back());
+}
+
+void Chain::index_add(const Block& b) { index_[hash_key(b.hash)] = b.height; }
+
+int64_t Chain::find(const uint8_t hash[32]) const {
+  auto it = index_.find(hash_key(hash));
+  return it == index_.end() ? -1 : int64_t(it->second);
 }
 
 bool Chain::valid_child(const BlockHeader& header, const Block& parent) const {
@@ -81,27 +95,45 @@ bool Chain::valid_child(const BlockHeader& header, const Block& parent) const {
 bool Chain::append(const BlockHeader& header) {
   if (!valid_child(header, tip())) return false;
   blocks_.push_back(Block::from_header(header, height() + 1));
+  index_add(blocks_.back());
   return true;
 }
 
 bool Chain::try_adopt(const std::vector<BlockHeader>& headers) {
   if (headers.size() <= height()) return false;  // not strictly longer
-  // Validate the candidate chain above our genesis.
-  const Block* parent = &blocks_[0];
-  std::vector<Block> candidate;
-  candidate.reserve(headers.size());
-  for (const BlockHeader& h : headers) {
-    if (!valid_child(h, *parent)) return false;
-    candidate.push_back(Block::from_header(h, parent->height + 1));
-    parent = &candidate.back();
+  // Fork point: the longest prefix of `headers` byte-identical to our own
+  // blocks 1..height(). Shared blocks were fully validated when first
+  // adopted, so only the divergent suffix needs hashing and validation —
+  // adopt cost is O(suffix), not O(height).
+  uint8_t ours[kHeaderSize], theirs[kHeaderSize];
+  size_t fork = 0;  // number of leading shared headers
+  while (fork + 1 < blocks_.size()) {
+    blocks_[fork + 1].header.serialize(ours);
+    headers[fork].serialize(theirs);
+    if (std::memcmp(ours, theirs, kHeaderSize) != 0) break;
+    ++fork;
   }
-  blocks_.resize(1);  // keep genesis
-  blocks_.insert(blocks_.end(), candidate.begin(), candidate.end());
+  const Block* parent = &blocks_[fork];
+  std::vector<Block> suffix;
+  suffix.reserve(headers.size() - fork);
+  for (size_t i = fork; i < headers.size(); ++i) {
+    if (!valid_child(headers[i], *parent)) return false;  // chain unchanged
+    suffix.push_back(Block::from_header(headers[i], parent->height + 1));
+    parent = &suffix.back();
+  }
+  rollback_to(fork);
+  for (const Block& b : suffix) {
+    blocks_.push_back(b);
+    index_add(blocks_.back());
+  }
   return true;
 }
 
 void Chain::rollback_to(uint64_t new_height) {
-  if (new_height + 1 < blocks_.size()) blocks_.resize(new_height + 1);
+  while (blocks_.size() > new_height + 1) {
+    index_.erase(hash_key(blocks_.back().hash));
+    blocks_.pop_back();
+  }
 }
 
 std::vector<uint8_t> Chain::save() const {
@@ -145,15 +177,14 @@ bool Node::submit(const BlockHeader& header) { return chain_.append(header); }
 RecvResult Node::on_block_received(const BlockHeader& header) {
   uint8_t h[32];
   header.hash(h);
-  if (std::memcmp(h, chain_.tip().hash, 32) == 0) return RecvResult::kDuplicate;
+  // O(1) duplicate check via the chain's hash index (was an O(height)
+  // scan — O(height^2) over a long simulation).
+  if (chain_.find(h) >= 0) return RecvResult::kDuplicate;
   if (std::memcmp(header.prev_hash, chain_.tip().hash, 32) == 0) {
     return chain_.append(header) ? RecvResult::kAppended : RecvResult::kInvalid;
   }
-  // Does not extend our tip. If it matches an existing block, duplicate;
-  // otherwise the caller must fetch the sender's chain for longest-chain
-  // resolution (SURVEY.md §3.3).
-  for (uint64_t i = 0; i <= chain_.height(); ++i)
-    if (std::memcmp(chain_.at(i).hash, h, 32) == 0) return RecvResult::kDuplicate;
+  // Does not extend our tip and is not a block we have: the caller must
+  // fetch the sender's chain for longest-chain resolution (SURVEY.md §3.3).
   return RecvResult::kStaleOrFork;
 }
 

@@ -25,6 +25,7 @@
 #include <cstdint>
 #include <cstring>
 #include <string>
+#include <unordered_map>
 #include <vector>
 
 namespace chaincore {
@@ -78,13 +79,19 @@ class Chain {
   bool valid_child(const BlockHeader& header, const Block& parent) const;
 
   // Longest-chain rule: `headers` is a full replacement chain, heights
-  // 1..headers.size(), child of this chain's genesis. Adopts (replacing
-  // everything above genesis) iff it is fully valid and strictly longer than
-  // the current chain. Returns true on adoption.
+  // 1..headers.size(), child of this chain's genesis. Adopts iff it is
+  // fully valid and strictly longer than the current chain. Returns true
+  // on adoption. Cost is O(suffix): the longest byte-identical prefix
+  // shared with the current chain was already validated when first
+  // adopted, so only the divergent suffix is hashed and checked.
   bool try_adopt(const std::vector<BlockHeader>& headers);
 
   // Drops blocks above `new_height` (reorg rollback primitive).
   void rollback_to(uint64_t new_height);
+
+  // Height of the block with this hash, or -1 if absent. O(1) via the
+  // hash index (kills the O(chain) duplicate scan in Node receive).
+  int64_t find(const uint8_t hash[32]) const;
 
   // Serialization: concatenated 80-byte headers (heights 0..tip).
   std::vector<uint8_t> save() const;
@@ -94,7 +101,11 @@ class Chain {
                    Chain* out);
 
  private:
+  void index_add(const Block& b);
+
   std::vector<Block> blocks_;
+  // block hash (32 raw bytes) -> height; kept in sync by every mutation.
+  std::unordered_map<std::string, uint64_t> index_;
   uint32_t difficulty_bits_;
 };
 

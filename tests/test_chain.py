@@ -118,3 +118,68 @@ def test_save_load_roundtrip():
     c = core.Node(DIFF, 2)
     assert not c.load(bad)
     assert c.height == 0
+
+
+def test_receive_deep_duplicate_is_o1_indexed():
+    # A block buried far below the tip must be recognized as a duplicate
+    # (index lookup), not reported stale-or-fork.
+    a, b = core.Node(DIFF, 0), core.Node(DIFF, 1)
+    for i in range(5):
+        hdr = mine_on(a, b"blk%d" % i)
+        a.submit(hdr)
+        b.receive(hdr)
+    deep = a.block_header(2)
+    assert a.receive(deep) == core.RecvResult.DUPLICATE
+    assert b.receive(deep) == core.RecvResult.DUPLICATE
+
+
+def test_adopt_shared_prefix_fork_point():
+    # a and b share a 3-block prefix, then diverge; b mines 2 more.
+    # Adoption must roll back only the divergent suffix and land on b's tip.
+    a, b = core.Node(DIFF, 0), core.Node(DIFF, 1)
+    for i in range(3):
+        hdr = mine_on(a, b"shared%d" % i)
+        a.submit(hdr)
+        assert b.receive(hdr) == core.RecvResult.APPENDED
+    a.submit(mine_on(a, b"a-only"))
+    for p in (b"b4", b"b5", b"b6"):
+        b.submit(mine_on(b, p))
+    shared2 = a.block_hash(2)
+    assert a.adopt_chain(b.all_headers()) == core.RecvResult.REORGED
+    assert a.height == 6 and a.tip_hash == b.tip_hash
+    assert a.block_hash(2) == shared2  # shared prefix untouched
+    # Re-adopting the identical chain is not strictly longer -> ignored.
+    assert a.adopt_chain(b.all_headers()) == core.RecvResult.IGNORED_SHORTER
+
+
+def test_adopt_invalid_suffix_leaves_chain_unchanged():
+    # Shared prefix + tampered suffix: the reorg must be rejected with the
+    # original chain (and its index) fully intact.
+    a, b = core.Node(DIFF, 0), core.Node(DIFF, 1)
+    for i in range(2):
+        hdr = mine_on(a, b"p%d" % i)
+        a.submit(hdr)
+        b.receive(hdr)
+    a.submit(mine_on(a, b"a2"))
+    for p in (b"b2", b"b3", b"b4"):
+        b.submit(mine_on(b, p))
+    headers = b.all_headers()
+    tampered = headers[:-1] + [core.set_nonce(headers[-1], 1)]
+    if core.leading_zero_bits(core.header_hash(tampered[-1])) < DIFF:
+        tip_before = a.tip_hash
+        assert a.adopt_chain(tampered) == core.RecvResult.INVALID
+        assert a.height == 3 and a.tip_hash == tip_before
+        # Index still consistent: old tip is a duplicate, not a fork.
+        assert a.receive(a.block_header(3)) == core.RecvResult.DUPLICATE
+
+
+def test_rollback_prunes_index():
+    # After a rollback, the dropped block is no longer "duplicate" — it can
+    # be re-received as a fresh extension of the new tip.
+    a = core.Node(DIFF, 0)
+    for p in (b"1", b"2"):
+        a.submit(mine_on(a, p))
+    dropped = a.block_header(2)
+    a.rollback(1)
+    assert a.receive(dropped) == core.RecvResult.APPENDED
+    assert a.height == 2
