@@ -11,6 +11,8 @@ from __future__ import annotations
 import abc
 import dataclasses
 
+from ..config import ConfigError
+
 
 @dataclasses.dataclass(frozen=True)
 class SearchResult:
@@ -53,7 +55,7 @@ def get_backend(name: str, **kwargs) -> MinerBackend:
     try:
         return _REGISTRY[name](**kwargs)
     except KeyError:
-        raise ValueError(f"unknown miner_backend {name!r}; "
+        raise ConfigError(f"unknown miner_backend {name!r}; "
                          f"known: {sorted(_REGISTRY)}") from None
 
 

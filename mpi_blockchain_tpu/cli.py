@@ -16,7 +16,7 @@ import json
 import sys
 import time
 
-from .config import MinerConfig, PRESETS
+from .config import ConfigError, MinerConfig, PRESETS
 
 
 def _add_config_args(p: argparse.ArgumentParser) -> None:
@@ -338,10 +338,13 @@ def main(argv: list[str] | None = None) -> int:
     args = parser.parse_args(argv)
     try:
         return args.fn(args)
-    except ValueError as e:
+    except ConfigError as e:
         # Config/topology errors (oversubscribed mesh, bad kernel/batch,
         # invalid checkpoint) surface as one clean JSON line, not a
         # traceback — the launch-form contract of the reference's CLI.
+        # Only the dedicated ConfigError class gets this treatment; any
+        # other exception (including plain ValueError from a genuine bug)
+        # keeps its traceback.
         print(json.dumps({"event": "error", "error": str(e)},
                          sort_keys=True))
         return 2

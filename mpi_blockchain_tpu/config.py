@@ -8,6 +8,13 @@ from __future__ import annotations
 import dataclasses
 
 
+class ConfigError(ValueError):
+    """Invalid configuration or topology (bad kernel/batch, oversubscribed
+    mesh, corrupt checkpoint, ...). The CLI converts exactly this class to
+    a clean JSON error line; other exceptions keep their tracebacks.
+    Subclasses ValueError so pre-existing `except ValueError` sites hold."""
+
+
 @dataclasses.dataclass(frozen=True)
 class MinerConfig:
     difficulty_bits: int = 16

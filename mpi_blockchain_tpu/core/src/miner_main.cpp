@@ -10,6 +10,7 @@
 // Payloads are "block:<height>" — the Python MinerConfig default — so
 // `python -m mpi_blockchain_tpu mine --difficulty D --blocks N --out f`
 // and `./chaincore_miner D N T f` produce the same bytes.
+#include <algorithm>
 #include <atomic>
 #include <cstdint>
 #include <cstdio>
@@ -48,12 +49,20 @@ uint64_t search_range(const BlockHeader& header, uint64_t start,
 
 uint64_t mine_block(const BlockHeader& cand, int n_threads, uint64_t slice,
                     std::atomic<uint64_t>* tried) {
-  for (uint64_t base = 0; base < (1ull << 32); base += n_threads * slice) {
+  constexpr uint64_t kNonceEnd = 1ull << 32;
+  for (uint64_t base = 0; base < kNonceEnd; base += n_threads * slice) {
     std::vector<uint64_t> found(n_threads, UINT64_MAX);
     std::vector<std::thread> threads;
     for (int t = 0; t < n_threads; ++t) {
-      threads.emplace_back([&, t] {
-        found[t] = search_range(cand, base + t * slice, slice, tried);
+      // Clamp the final round to the 2^32 nonce-space edge: an unclamped
+      // range would wrap through the uint32 cast and re-test round-0
+      // nonces.
+      uint64_t start = base + t * slice;
+      uint64_t count = start >= kNonceEnd
+                           ? 0
+                           : std::min(slice, kNonceEnd - start);
+      threads.emplace_back([&, t, start, count] {
+        found[t] = search_range(cand, start, count, tried);
       });
     }
     for (auto& th : threads) th.join();

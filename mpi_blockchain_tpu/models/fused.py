@@ -33,6 +33,7 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from .. import core
+from ..config import ConfigError
 from ..ops.sha256_jnp import (IV, NOT_FOUND_U32, _bswap32, compress,
                               sha256d_words_from_midstate)
 from ..parallel.mesh import replicated_host_value
@@ -143,7 +144,7 @@ class FusedMiner:
     def __init__(self, config, node_id: int = 0, blocks_per_call: int = 16,
                  mesh=None, log_fn=None):
         if blocks_per_call < 1:
-            raise ValueError(
+            raise ConfigError(
                 f"blocks_per_call must be >= 1, got {blocks_per_call}")
         self.config = config
         self.node = core.Node(config.difficulty_bits, node_id)

@@ -23,8 +23,10 @@ def select_kernel(kernel: str, batch_size: int, difficulty_bits: int,
     elsewhere. Returns (fn, effective_kernel_name). With shard=False the fn
     is jit'd and callable from the host; with shard=True it is the unjitted
     core (midstate, tail_w, base) -> (count, min_nonce) for use inside
-    shard_map. Falls back from pallas to jnp with a visible warning (never
-    silently, so bench labels stay honest).
+    shard_map. Only an "auto" choice falls back from pallas to jnp (with a
+    visible warning, so bench labels stay honest); an EXPLICIT "pallas"
+    request that cannot be honored raises ConfigError — a user's explicit
+    choice must never silently degrade.
 
     early_exit=True (pallas only — the jnp kernel ignores it and sweeps the
     full batch) skips tiles past the first qualifying one: min_nonce stays
@@ -32,16 +34,24 @@ def select_kernel(kernel: str, batch_size: int, difficulty_bits: int,
     """
     import jax
 
+    from ..config import ConfigError
+
+    requested = kernel
     if kernel == "auto":
         kernel = "pallas" if jax.default_backend() == "tpu" else "jnp"
     if kernel == "pallas":
         try:
             from .sha256_pallas import (TILE, make_pallas_sweep_fn,
                                         pallas_sweep_core)
-            # Eager, so sub-tile batches fall back here (with the warning)
-            # instead of raising mid-trace inside a caller's mine loop.
+            # Eager checks, so bad requests surface here instead of
+            # raising mid-trace inside a caller's mine loop: Mosaic can
+            # only lower on a real TPU, and batches must tile evenly.
+            if jax.default_backend() != "tpu":
+                raise ConfigError(
+                    f"kernel='pallas' requires a TPU platform (current: "
+                    f"{jax.default_backend()})")
             if batch_size % TILE != 0:
-                raise ValueError(
+                raise ConfigError(
                     f"batch_size {batch_size} not a multiple of {TILE}")
             if shard:
                 return functools.partial(
@@ -50,14 +60,20 @@ def select_kernel(kernel: str, batch_size: int, difficulty_bits: int,
                     early_exit=early_exit), "pallas"
             return make_pallas_sweep_fn(batch_size, difficulty_bits,
                                         early_exit=early_exit), "pallas"
-        except Exception as e:  # pallas unavailable on this platform
+        except Exception as e:
+            if requested == "pallas":
+                if isinstance(e, ConfigError):
+                    raise
+                raise ConfigError(
+                    f"kernel='pallas' requested but unavailable "
+                    f"({type(e).__name__}: {e})") from e
             from ..utils.logging import get_logger
             get_logger().warning(
                 "pallas sweep kernel unavailable (%s: %s); falling back to "
                 "the jnp kernel", type(e).__name__, e)
             kernel = "jnp"
     if kernel != "jnp":
-        raise ValueError(f"unknown sweep kernel {kernel!r}")
+        raise ConfigError(f"unknown sweep kernel {kernel!r}")
     if shard:
         return (lambda ms, tw, base: sweep_core(
             ms, tw, base, batch_size, difficulty_bits)), "jnp"

@@ -145,7 +145,8 @@ def difficulty_mask(digest_words, difficulty_bits: int):
         return (h0 == np.uint32(0)) & (h1 < np.uint32(1 << (64 - d)))
     if d == 64:
         return (h0 == np.uint32(0)) & (h1 == np.uint32(0))
-    raise ValueError(f"difficulty_bits {d} > 64 unsupported")
+    from ..config import ConfigError
+    raise ConfigError(f"difficulty_bits {d} > 64 unsupported")
 
 
 def sweep_core(midstate, tail_w, base_nonce, batch_size: int,

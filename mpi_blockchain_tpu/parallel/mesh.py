@@ -18,6 +18,8 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+
+from ..config import ConfigError
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
@@ -40,7 +42,7 @@ def make_miner_mesh(n_miners: int) -> Mesh:
     """A 1-D ('miners',) mesh over the first n_miners local devices."""
     devices = jax.devices()
     if len(devices) < n_miners:
-        raise ValueError(
+        raise ConfigError(
             f"need {n_miners} devices for the miners mesh, have "
             f"{len(devices)} (tests: XLA_FLAGS="
             f"--xla_force_host_platform_device_count={n_miners})")

@@ -20,7 +20,7 @@ from typing import Callable
 
 from . import core
 from .backend import MinerBackend, get_backend
-from .config import MinerConfig
+from .config import ConfigError, MinerConfig
 
 
 @dataclasses.dataclass
@@ -61,12 +61,18 @@ class SimNode:
         # steps instead of restarting at nonce 0 (restarting would let a
         # slower group never finish a block at higher difficulty).
         self._next_nonce = 0
+        # Bumped when the 2^32 nonce space is exhausted without a winner:
+        # it varies the candidate payload (hence data_hash), opening a
+        # fresh search space instead of re-sweeping dead nonces forever.
+        self._extra_nonce = 0
         self._tip_at_start = self.node.tip_hash
 
     def _candidate(self) -> bytes:
         data = f"{self.config.data_prefix}:g{self.id}:" \
-               f"{self.node.height + 1}".encode()
-        return self.node.make_candidate(data)
+               f"{self.node.height + 1}"
+        if self._extra_nonce:
+            data += f":x{self._extra_nonce}"
+        return self.node.make_candidate(data.encode())
 
     def mine_step(self, nonce_budget: int) -> bytes | None:
         """Searches up to nonce_budget nonces; returns a mined header or None.
@@ -78,6 +84,7 @@ class SimNode:
         tip = self.node.tip_hash
         if tip != self._tip_at_start:
             self._next_nonce = 0
+            self._extra_nonce = 0
             self._tip_at_start = tip
         cand = self._candidate()
         res = self.backend.search(cand, self.config.difficulty_bits,
@@ -86,12 +93,17 @@ class SimNode:
         if res.nonce is None:
             self._next_nonce += nonce_budget
             if self._next_nonce >= 1 << 32:
-                self._next_nonce = 0  # exhausted: wrap (different data next block)
+                # Nonce space exhausted at this height: bump the extra
+                # nonce so the next candidate carries different payload
+                # data (new data_hash => a genuinely fresh search space).
+                self._extra_nonce += 1
+                self._next_nonce = 0
             return None
         winner = core.set_nonce(cand, res.nonce)
         assert self.node.submit(winner), "own block failed validation"
         self.stats.blocks_mined += 1
         self._next_nonce = 0
+        self._extra_nonce = 0
         self._tip_at_start = self.node.tip_hash
         return winner
 
@@ -139,9 +151,16 @@ class Network:
                                    self.step_count + self.delay_steps,
                                    sender, header80))
 
-    def deliver_due(self) -> None:
-        due = [m for m in self.queue if m.deliver_step <= self.step_count]
-        self.queue = [m for m in self.queue if m.deliver_step > self.step_count]
+    def deliver_due(self, horizon: int = 0) -> None:
+        """Delivers messages with deliver_step <= step_count + horizon.
+
+        horizon > 0 is the post-target flush: in-flight announcements may
+        be due up to delay_steps in the future, and no further mining steps
+        will advance the clock to meet them.
+        """
+        cutoff = self.step_count + horizon
+        due = [m for m in self.queue if m.deliver_step <= cutoff]
+        self.queue = [m for m in self.queue if m.deliver_step > cutoff]
         due.sort(key=lambda m: (m.send_step, m.sender))
         for m in due:
             sender_node = self.nodes[m.sender]
@@ -182,9 +201,9 @@ class Network:
         while self.step_count < max_steps:
             self.step(nonce_budget)
             if all(n.node.height >= target_height for n in self.nodes):
-                # Flush in-flight announcements, then check for one chain.
-                for _ in range(self.delay_steps + 1):
-                    self.deliver_due()
+                # Flush in-flight announcements (due up to delay_steps
+                # ahead of the clock), then check for one chain.
+                self.deliver_due(horizon=self.delay_steps)
                 if self.converged():
                     return self.step_count
         raise RuntimeError(f"no convergence in {max_steps} steps")
@@ -225,7 +244,7 @@ def run_adversarial(config: MinerConfig | None = None,
     delivery delay and seeded random message loss on top of the partition.
     """
     if n_groups < 2:
-        raise ValueError(f"n_groups must be >= 2, got {n_groups}")
+        raise ConfigError(f"n_groups must be >= 2, got {n_groups}")
     cfg = config if config is not None else MinerConfig(
         difficulty_bits=8, n_blocks=target_height, backend="cpu")
     nodes = [SimNode(i, cfg) for i in range(n_groups)]

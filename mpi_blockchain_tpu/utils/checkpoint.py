@@ -14,7 +14,7 @@ import json
 import pathlib
 
 from .. import core
-from ..config import MinerConfig
+from ..config import ConfigError, MinerConfig
 
 
 def save_chain(node: core.Node, path: str | pathlib.Path,
@@ -35,12 +35,16 @@ def load_chain(path: str | pathlib.Path, difficulty_bits: int,
     path = pathlib.Path(path)
     sidecar = path.with_suffix(path.suffix + ".json")
     if sidecar.exists():
-        meta = json.loads(sidecar.read_text())
+        try:
+            meta = json.loads(sidecar.read_text())
+        except json.JSONDecodeError as e:
+            raise ConfigError(
+                f"corrupt checkpoint sidecar {sidecar}: {e}") from e
         if meta.get("difficulty_bits") != difficulty_bits:
-            raise ValueError(
+            raise ConfigError(
                 f"checkpoint difficulty {meta.get('difficulty_bits')} != "
                 f"requested {difficulty_bits}")
     node = core.Node(difficulty_bits, node_id)
     if not node.load(path.read_bytes()):
-        raise ValueError(f"invalid or corrupt chain checkpoint: {path}")
+        raise ConfigError(f"invalid or corrupt chain checkpoint: {path}")
     return node

@@ -176,3 +176,39 @@ def test_cli_bench_chain_sharded(capsys):
                "--miners", "8", "--kernel", "jnp"])
     out = json.loads(capsys.readouterr().out)
     assert rc == 0 and out["n_miners"] == 8 and out["n_blocks"] == 2
+
+
+def test_cli_explicit_pallas_off_tpu_clean_error(capsys):
+    # An explicit --kernel pallas must never silently degrade to jnp: off
+    # the real TPU it is a clean ConfigError JSON line (ADVICE r1 #3).
+    import jax
+    if jax.default_backend() == "tpu":
+        pytest.skip("pallas is genuinely available on the real chip")
+    rc = main(["mine", "--difficulty", "8", "--blocks", "1", "--backend",
+               "tpu", "--kernel", "pallas"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 2 and "pallas" in out["error"]
+
+
+def test_cli_bad_groups_clean_error(capsys):
+    rc = main(["sim", "--blocks", "2", "--groups", "1"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 2 and "n_groups" in out["error"]
+
+
+def test_unexpected_value_error_keeps_traceback():
+    # Only ConfigError gets the clean-JSON treatment; a plain ValueError
+    # from a genuine bug must propagate (ADVICE r1 #4).
+    import mpi_blockchain_tpu.cli as cli
+
+    def boom(args):
+        raise ValueError("programming error")
+
+    parser_args = ["info"]
+    orig = cli.cmd_info
+    cli.cmd_info = boom
+    try:
+        with pytest.raises(ValueError, match="programming error"):
+            main(parser_args)
+    finally:
+        cli.cmd_info = orig

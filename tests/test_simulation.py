@@ -119,3 +119,36 @@ def test_three_group_partition_converges():
     assert net.converged()
     assert len(net.nodes) == 3
     assert all(n.node.height >= 4 for n in net.nodes)
+
+
+def test_nonce_exhaustion_opens_fresh_search_space():
+    # At an unsatisfiable difficulty, exhausting the 2^32 nonce space must
+    # bump the extra nonce — changing the candidate payload (new data_hash)
+    # so the next sweep covers genuinely fresh ground, not dead nonces.
+    cfg = MinerConfig(difficulty_bits=64, n_blocks=1, backend="cpu")
+    node = SimNode(0, cfg)
+    before = node._candidate()
+    node._next_nonce = (1 << 32) - 256
+    assert node.mine_step(256) is None
+    assert node._extra_nonce == 1 and node._next_nonce == 0
+    assert node._candidate() != before
+    # And the overall run terminates with a clear error, not a livelock.
+    net = Network([SimNode(0, cfg), SimNode(1, cfg)])
+    with pytest.raises(RuntimeError, match="no convergence"):
+        net.run(target_height=1, max_steps=5, nonce_budget=1 << 8)
+
+
+def test_flush_delivers_future_due_messages():
+    # A message whose deliver_step lies past the current clock must land
+    # when flushed with a horizon (the post-target flush path) — with
+    # delay_steps > 1 the old flush could never deliver it.
+    net = make_net(2, delay_steps=3)
+    a, b = net.nodes
+    hdr = None
+    while hdr is None:
+        hdr = a.mine_step(1 << 12)
+    net.broadcast(0, hdr)
+    net.deliver_due()            # not due yet: nothing happens
+    assert b.node.height == 0 and len(net.queue) == 1
+    net.deliver_due(horizon=net.delay_steps)
+    assert b.node.height == 1 and net.queue == []
