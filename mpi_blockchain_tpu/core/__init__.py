@@ -1,114 +1,69 @@
-"""Python bindings for the C++ chain core (ctypes over libchaincore.so).
+"""Python bindings for the C++ chain core.
 
 The C++ ``Block``/``Chain``/``Node`` classes are the canonical chain state
 (BASELINE.json north-star); this module is a thin, typed veneer. Headers
 cross the boundary as 80-byte serialized blobs, hashes as 32-byte digests.
+
+Two interchangeable binding layers over the same libchaincore sources:
+
+* **pybind11** (``src/pybind_module.cpp``) — the mechanism the north-star
+  names. Header-only pybind11 is vendored in this image inside the torch /
+  tensorflow include trees, so the extension builds offline.
+* **ctypes** over the C ABI (``src/capi.cpp``) — the fallback when no
+  pybind11 headers exist.
+
+``MBT_BINDING={auto,pybind11,ctypes}`` forces the choice (auto prefers
+pybind11); ``core.BINDING`` records what actually loaded. Both expose the
+exact same surface, and the backend-equivalence suite runs against either.
 """
 from __future__ import annotations
 
-import ctypes
 import dataclasses
+import os
 import struct
 
 import numpy as np
 
-from .build import ensure_built
-
 HEADER_SIZE = 80
 NOT_FOUND = 2**64 - 1
 
-_lib = ctypes.CDLL(str(ensure_built()))
+_CHOICE = os.environ.get("MBT_BINDING", "auto")
+if _CHOICE not in ("auto", "pybind11", "ctypes"):
+    raise ValueError(f"MBT_BINDING must be auto|pybind11|ctypes, "
+                     f"got {_CHOICE!r}")
 
-_u8p = ctypes.POINTER(ctypes.c_uint8)
-_u32p = ctypes.POINTER(ctypes.c_uint32)
-_u64p = ctypes.POINTER(ctypes.c_uint64)
+_pb = None
+if _CHOICE in ("auto", "pybind11"):
+    try:
+        from .build import ensure_pybind_built
+        _pb = ensure_pybind_built()
+    except Exception:
+        if _CHOICE == "pybind11":
+            raise
 
-_lib.cc_sha256.argtypes = [ctypes.c_char_p, ctypes.c_uint64, _u8p]
-_lib.cc_sha256d.argtypes = [ctypes.c_char_p, ctypes.c_uint64, _u8p]
-_lib.cc_header_hash.argtypes = [ctypes.c_char_p, _u8p]
-_lib.cc_leading_zero_bits.argtypes = [ctypes.c_char_p]
-_lib.cc_leading_zero_bits.restype = ctypes.c_int
-_lib.cc_header_midstate.argtypes = [ctypes.c_char_p, _u32p, _u32p]
-_lib.cc_search.argtypes = [ctypes.c_char_p, ctypes.c_uint64, ctypes.c_uint64,
-                           ctypes.c_uint32, _u64p]
-_lib.cc_search.restype = ctypes.c_uint64
+if _pb is not None:
+    BINDING = "pybind11"
+    sha256 = _pb.sha256
+    sha256d = _pb.sha256d
+    header_hash = _pb.header_hash
+    leading_zero_bits = _pb.leading_zero_bits
+    cpu_search = _pb.cpu_search
+    Node = _pb.Node
 
-_lib.cc_node_new.argtypes = [ctypes.c_uint32, ctypes.c_int]
-_lib.cc_node_new.restype = ctypes.c_void_p
-_lib.cc_node_free.argtypes = [ctypes.c_void_p]
-_lib.cc_node_height.argtypes = [ctypes.c_void_p]
-_lib.cc_node_height.restype = ctypes.c_uint64
-_lib.cc_node_difficulty.argtypes = [ctypes.c_void_p]
-_lib.cc_node_difficulty.restype = ctypes.c_uint32
-_lib.cc_node_tip_hash.argtypes = [ctypes.c_void_p, _u8p]
-_lib.cc_node_block_hash.argtypes = [ctypes.c_void_p, ctypes.c_uint64, _u8p]
-_lib.cc_node_block_header.argtypes = [ctypes.c_void_p, ctypes.c_uint64, _u8p]
-_lib.cc_node_make_candidate.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
-                                        ctypes.c_uint64, _u8p]
-_lib.cc_node_submit.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
-_lib.cc_node_submit.restype = ctypes.c_int
-_lib.cc_node_receive.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
-_lib.cc_node_receive.restype = ctypes.c_int
-_lib.cc_node_adopt_chain.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
-                                     ctypes.c_uint64]
-_lib.cc_node_adopt_chain.restype = ctypes.c_int
-_lib.cc_node_save.argtypes = [ctypes.c_void_p, _u8p]
-_lib.cc_node_save.restype = ctypes.c_uint64
-_lib.cc_node_load.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
-                              ctypes.c_uint64]
-_lib.cc_node_load.restype = ctypes.c_int
-_lib.cc_node_rollback.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    def header_midstate(header80: bytes) -> tuple[np.ndarray, np.ndarray]:
+        """Midstate after chunk 1 + the 16 chunk-2 words (nonce word 3).
 
-
-def _out_buf(n: int):
-    return (ctypes.c_uint8 * n)()
-
-
-def sha256(data: bytes) -> bytes:
-    out = _out_buf(32)
-    _lib.cc_sha256(data, len(data), out)
-    return bytes(out)
-
-
-def sha256d(data: bytes) -> bytes:
-    out = _out_buf(32)
-    _lib.cc_sha256d(data, len(data), out)
-    return bytes(out)
-
-
-def header_hash(header80: bytes) -> bytes:
-    assert len(header80) == HEADER_SIZE
-    out = _out_buf(32)
-    _lib.cc_header_hash(header80, out)
-    return bytes(out)
-
-
-def leading_zero_bits(digest32: bytes) -> int:
-    assert len(digest32) == 32
-    return _lib.cc_leading_zero_bits(digest32)
-
-
-def header_midstate(header80: bytes) -> tuple[np.ndarray, np.ndarray]:
-    """Midstate after chunk 1 and the 16 chunk-2 words (nonce word index 3).
-
-    Returns uint32 arrays (8,) and (16,) shared bit-for-bit with the TPU
-    backend's sweep kernel.
-    """
-    assert len(header80) == HEADER_SIZE
-    state = (ctypes.c_uint32 * 8)()
-    tail = (ctypes.c_uint32 * 16)()
-    _lib.cc_header_midstate(header80, state, tail)
-    return (np.frombuffer(bytes(state), np.uint32).copy(),
-            np.frombuffer(bytes(tail), np.uint32).copy())
-
-
-def cpu_search(header80: bytes, start_nonce: int, count: int,
-               difficulty_bits: int) -> tuple[int | None, int]:
-    """Sequential lowest-nonce search. Returns (nonce or None, hashes_tried)."""
-    tried = ctypes.c_uint64(0)
-    n = _lib.cc_search(header80, start_nonce, count, difficulty_bits,
-                       ctypes.byref(tried))
-    return (None if n == NOT_FOUND else n), tried.value
+        Returns uint32 arrays (8,) and (16,) shared bit-for-bit with the
+        TPU backend's sweep kernel.
+        """
+        state, tail = _pb.header_midstate(header80)
+        return (np.frombuffer(state, np.uint32).copy(),
+                np.frombuffer(tail, np.uint32).copy())
+else:
+    BINDING = "ctypes"
+    from ._ctypes_binding import (Node, cpu_search,          # noqa: F401
+                                  header_hash, header_midstate,
+                                  leading_zero_bits, sha256, sha256d)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -146,78 +101,3 @@ class RecvResult:
     INVALID = 3
     REORGED = 4
     IGNORED_SHORTER = 5
-
-
-class Node:
-    """Handle to a C++ chaincore::Node — the canonical chain state."""
-
-    def __init__(self, difficulty_bits: int, node_id: int = 0):
-        self._h = _lib.cc_node_new(difficulty_bits, node_id)
-        self.node_id = node_id
-
-    def __del__(self):
-        h = getattr(self, "_h", None)
-        lib = globals().get("_lib")
-        if h and lib is not None:
-            lib.cc_node_free(h)
-            self._h = None
-
-    @property
-    def height(self) -> int:
-        return _lib.cc_node_height(self._h)
-
-    @property
-    def difficulty_bits(self) -> int:
-        return _lib.cc_node_difficulty(self._h)
-
-    @property
-    def tip_hash(self) -> bytes:
-        out = _out_buf(32)
-        _lib.cc_node_tip_hash(self._h, out)
-        return bytes(out)
-
-    def block_hash(self, height: int) -> bytes:
-        if not 0 <= height <= self.height:
-            raise IndexError(f"height {height} not in [0, {self.height}]")
-        out = _out_buf(32)
-        _lib.cc_node_block_hash(self._h, height, out)
-        return bytes(out)
-
-    def block_header(self, height: int) -> bytes:
-        if not 0 <= height <= self.height:
-            raise IndexError(f"height {height} not in [0, {self.height}]")
-        out = _out_buf(HEADER_SIZE)
-        _lib.cc_node_block_header(self._h, height, out)
-        return bytes(out)
-
-    def make_candidate(self, data: bytes) -> bytes:
-        out = _out_buf(HEADER_SIZE)
-        _lib.cc_node_make_candidate(self._h, data, len(data), out)
-        return bytes(out)
-
-    def submit(self, header80: bytes) -> bool:
-        return bool(_lib.cc_node_submit(self._h, header80))
-
-    def receive(self, header80: bytes) -> int:
-        return _lib.cc_node_receive(self._h, header80)
-
-    def adopt_chain(self, headers80: list[bytes]) -> int:
-        blob = b"".join(headers80)
-        return _lib.cc_node_adopt_chain(self._h, blob, len(headers80))
-
-    def save(self) -> bytes:
-        out = _out_buf((self.height + 1) * HEADER_SIZE)
-        n = _lib.cc_node_save(self._h, out)
-        return bytes(out)[: n * HEADER_SIZE]
-
-    def load(self, blob: bytes) -> bool:
-        if not blob or len(blob) % HEADER_SIZE != 0:
-            return False
-        return bool(_lib.cc_node_load(self._h, blob, len(blob) // HEADER_SIZE))
-
-    def rollback(self, new_height: int) -> None:
-        _lib.cc_node_rollback(self._h, new_height)
-
-    def all_headers(self) -> list[bytes]:
-        """Headers for heights 1..tip (the adopt_chain wire format)."""
-        return [self.block_header(i) for i in range(1, self.height + 1)]

@@ -1,20 +1,82 @@
-"""Builds libchaincore.so on demand (first import) via the Makefile."""
+"""Builds the C++ core on demand (first import) via the Makefile.
+
+Two binding artifacts:
+  libchaincore.so        — C ABI for the ctypes fallback binding
+  chaincore_pb<ext>.so   — pybind11 extension (the north-star's spec'd
+                           mechanism), buildable because this image vendors
+                           pybind11 headers inside the torch / tensorflow
+                           include trees (header-only, framework-agnostic).
+"""
 from __future__ import annotations
 
+import importlib.util
 import pathlib
 import subprocess
+import sysconfig
 
 _CORE_DIR = pathlib.Path(__file__).resolve().parent
 _LIB = _CORE_DIR / "libchaincore.so"
 _SRC = _CORE_DIR / "src"
 
 
+def _stale(artifact: pathlib.Path) -> bool:
+    if not artifact.exists():
+        return True
+    mtime = artifact.stat().st_mtime
+    return any(p.stat().st_mtime > mtime for p in _SRC.iterdir())
+
+
 def ensure_built() -> pathlib.Path:
-    """Compiles the C++ core if the .so is missing or older than any source."""
-    if _LIB.exists():
-        lib_mtime = _LIB.stat().st_mtime
-        stale = any(p.stat().st_mtime > lib_mtime for p in _SRC.iterdir())
-        if not stale:
-            return _LIB
-    subprocess.run(["make", "-s"], cwd=_CORE_DIR, check=True)
+    """Compiles the ctypes C ABI library if missing or out of date."""
+    if _stale(_LIB):
+        subprocess.run(["make", "-s"], cwd=_CORE_DIR, check=True)
     return _LIB
+
+
+def find_pybind11_include() -> str:
+    """Locates pybind11 headers: a real install, else torch/tf's vendored
+    copy (found via find_spec — no heavy framework import)."""
+    try:
+        import pybind11
+        return pybind11.get_include()
+    except ImportError:
+        pass
+    candidates = []
+    for pkg, subdirs in (("torch", ("include",)),
+                         ("tensorflow",
+                          ("include/external/pybind11/include",))):
+        spec = importlib.util.find_spec(pkg)
+        if spec and spec.submodule_search_locations:
+            for base in spec.submodule_search_locations:
+                candidates += [pathlib.Path(base) / s for s in subdirs]
+    for inc in candidates:
+        if (inc / "pybind11" / "pybind11.h").exists():
+            return str(inc)
+    raise FileNotFoundError(
+        "no pybind11 headers found (checked pip install + torch/tensorflow "
+        "vendored include trees)")
+
+
+def pybind_module_path() -> pathlib.Path:
+    return _CORE_DIR / ("chaincore_pb"
+                        + sysconfig.get_config_var("EXT_SUFFIX"))
+
+
+def ensure_pybind_built():
+    """Builds (if needed) and imports the pybind11 extension module.
+
+    Raises on any failure — the caller (core/__init__.py) decides whether
+    to fall back to ctypes or surface the error (MBT_BINDING=pybind11).
+    """
+    path = pybind_module_path()
+    if _stale(path):
+        subprocess.run(
+            ["make", "-s", "pybind",
+             f"PY_INC={sysconfig.get_paths()['include']}",
+             f"PB_INC={find_pybind11_include()}",
+             f"EXT_SUFFIX={sysconfig.get_config_var('EXT_SUFFIX')}"],
+            cwd=_CORE_DIR, check=True)
+    spec = importlib.util.spec_from_file_location("chaincore_pb", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod

@@ -1,11 +1,13 @@
-// extern "C" boundary for ctypes (the Python <-> C++ binding layer).
+// extern "C" boundary for ctypes — the FALLBACK Python <-> C++ binding.
 //
-// BASELINE.json's north-star names pybind11 for this boundary; pybind11 is
-// not available in this offline image, so the spec'd plugin boundary is
-// realized with the CPython-agnostic C ABI + ctypes (SURVEY.md §7 hard part
-// #7 explicitly sanctions this fallback). The architecture is unchanged: the
-// C++ Block/Node classes remain the canonical chain state; Python sees only
-// opaque Node handles, 80-byte serialized headers, and 32-byte digests.
+// BASELINE.json's north-star names pybind11 for this boundary, and since
+// round 2 the pybind11 extension (src/pybind_module.cpp, built against the
+// headers vendored in the image's torch/tensorflow include trees) is the
+// default. This CPython-agnostic C ABI stays as the fallback for
+// environments with no pybind11 headers (SURVEY.md §7 hard part #7). Both
+// bindings expose the identical surface: the C++ Block/Node classes remain
+// the canonical chain state; Python sees only opaque Node handles, 80-byte
+// serialized headers, and 32-byte digests.
 #include <cstdint>
 #include <cstring>
 #include <vector>

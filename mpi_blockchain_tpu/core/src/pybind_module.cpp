@@ -1,0 +1,207 @@
+// pybind11 bindings for the C++ chain core — the Python<->C++ boundary
+// named by the BASELINE.json north-star ("Block/Node C++ classes ...
+// exposed via pybind11").
+//
+// pybind11 is header-only; this image vendors its headers inside the torch
+// and tensorflow include trees, and the build (core/build.py) points -I at
+// whichever is present. The CPython-agnostic C ABI (capi.cpp + ctypes)
+// remains as the fallback binding when no pybind11 headers exist —
+// core/__init__.py selects at import time (MBT_BINDING={auto,pybind11,
+// ctypes}).
+//
+// The bound surface mirrors the ctypes veneer exactly: headers cross as
+// 80-byte bytes blobs, hashes as 32-byte digests, and the Node object is
+// the canonical chain state.
+#include <pybind11/pybind11.h>
+#include <pybind11/stl.h>
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <utility>
+#include <vector>
+
+#include "chain.hpp"
+#include "sha256.hpp"
+
+namespace py = pybind11;
+using namespace chaincore;
+
+namespace {
+
+py::bytes to_bytes(const uint8_t* p, size_t n) {
+  return py::bytes(reinterpret_cast<const char*>(p), n);
+}
+
+const uint8_t* data8(const std::string& s) {
+  return reinterpret_cast<const uint8_t*>(s.data());
+}
+
+const std::string& check80(const std::string& h) {
+  if (h.size() != kHeaderSize)
+    throw py::value_error("header must be exactly 80 bytes");
+  return h;
+}
+
+uint64_t checked_height(const Node& n, int64_t height) {
+  if (height < 0 || uint64_t(height) > n.height())
+    throw py::index_error("height " + std::to_string(height) +
+                          " not in [0, " + std::to_string(n.height()) + "]");
+  return uint64_t(height);
+}
+
+// Sequential lowest-nonce sweep (same contract as capi.cpp cc_search).
+// GIL released: the CPU miner_backend runs this from 8 "rank" threads.
+std::pair<uint64_t, uint64_t> search_impl(const std::string& header80,
+                                          uint64_t start_nonce,
+                                          uint64_t count,
+                                          uint32_t difficulty_bits) {
+  uint32_t midstate[8], tail[16];
+  header_midstate(data8(header80), midstate, tail);
+  uint64_t end = start_nonce + count;
+  if (end > 0x100000000ULL) end = 0x100000000ULL;
+  uint64_t tried = 0;
+  for (uint64_t n = start_nonce; n < end; ++n, ++tried) {
+    tail[3] = ((uint32_t(n) & 0xff) << 24) | ((uint32_t(n) & 0xff00) << 8) |
+              ((uint32_t(n) >> 8) & 0xff00) | (uint32_t(n) >> 24);
+    uint8_t digest[32];
+    sha256d_from_midstate(midstate, tail, digest);
+    if (leading_zero_bits(digest) >= int(difficulty_bits))
+      return {n, tried + 1};
+  }
+  return {UINT64_MAX, tried};
+}
+
+}  // namespace
+
+PYBIND11_MODULE(chaincore_pb, m) {
+  m.doc() = "pybind11 bindings for the chaincore C++ chain kernel";
+  m.attr("HEADER_SIZE") = py::int_(kHeaderSize);
+
+  // ---------- hashing primitives ----------
+  m.def("sha256", [](const py::bytes& data) {
+    std::string s = data;
+    uint8_t out[32];
+    sha256(data8(s), s.size(), out);
+    return to_bytes(out, 32);
+  });
+  m.def("sha256d", [](const py::bytes& data) {
+    std::string s = data;
+    uint8_t out[32];
+    sha256d(data8(s), s.size(), out);
+    return to_bytes(out, 32);
+  });
+  m.def("header_hash", [](const std::string& header80) {
+    uint8_t out[32];
+    sha256d(data8(check80(header80)), kHeaderSize, out);
+    return to_bytes(out, 32);
+  });
+  m.def("leading_zero_bits", [](const std::string& digest32) {
+    if (digest32.size() != 32)
+      throw py::value_error("digest must be 32 bytes");
+    return leading_zero_bits(data8(digest32));
+  });
+  m.def("header_midstate", [](const std::string& header80) {
+    uint32_t state[8], tail[16];
+    header_midstate(data8(check80(header80)), state, tail);
+    return py::make_tuple(
+        to_bytes(reinterpret_cast<uint8_t*>(state), sizeof state),
+        to_bytes(reinterpret_cast<uint8_t*>(tail), sizeof tail));
+  });
+
+  // ---------- CPU nonce search (the cpu miner_backend) ----------
+  m.def(
+      "cpu_search",
+      [](const std::string& header80, uint64_t start_nonce, uint64_t count,
+         uint32_t difficulty_bits) {
+        std::pair<uint64_t, uint64_t> r;
+        {
+          py::gil_scoped_release release;
+          r = search_impl(header80, start_nonce, count, difficulty_bits);
+        }
+        return py::make_tuple(
+            r.first == UINT64_MAX ? py::object(py::none())
+                                  : py::object(py::int_(r.first)),
+            r.second);
+      },
+      py::arg("header80"), py::arg("start_nonce"), py::arg("count"),
+      py::arg("difficulty_bits"));
+
+  // ---------- Node: the canonical chain state ----------
+  py::class_<Node>(m, "Node")
+      .def(py::init<uint32_t, int>(), py::arg("difficulty_bits"),
+           py::arg("node_id") = 0)
+      .def_property_readonly("height", &Node::height)
+      .def_property_readonly(
+          "difficulty_bits",
+          [](const Node& n) { return n.chain().difficulty_bits(); })
+      .def_property_readonly("node_id", &Node::id)
+      .def_property_readonly("tip_hash", [](const Node& n) {
+        return to_bytes(n.chain().tip().hash, 32);
+      })
+      .def("block_hash",
+           [](const Node& n, int64_t height) {
+             return to_bytes(n.chain().at(checked_height(n, height)).hash, 32);
+           })
+      .def("block_header",
+           [](const Node& n, int64_t height) {
+             uint8_t out[kHeaderSize];
+             n.chain().at(checked_height(n, height)).header.serialize(out);
+             return to_bytes(out, kHeaderSize);
+           })
+      .def("make_candidate",
+           [](const Node& n, const py::bytes& data) {
+             std::string s = data;
+             uint8_t out[kHeaderSize];
+             n.make_candidate(data8(s), s.size()).serialize(out);
+             return to_bytes(out, kHeaderSize);
+           })
+      .def("submit",
+           [](Node& n, const std::string& header80) {
+             return n.submit(BlockHeader::deserialize(data8(check80(
+                 header80))));
+           })
+      .def("receive",
+           [](Node& n, const std::string& header80) {
+             return int(n.on_block_received(
+                 BlockHeader::deserialize(data8(check80(header80)))));
+           })
+      .def("adopt_chain",
+           [](Node& n, const std::vector<std::string>& headers80) {
+             std::vector<BlockHeader> hs;
+             hs.reserve(headers80.size());
+             for (const std::string& h : headers80)
+               hs.push_back(BlockHeader::deserialize(data8(check80(h))));
+             return int(n.adopt_chain(hs));
+           })
+      .def("save",
+           [](const Node& n) {
+             std::vector<uint8_t> bytes = n.chain().save();
+             return to_bytes(bytes.data(), bytes.size());
+           })
+      .def("load",
+           [](Node& n, const std::string& blob) {
+             if (blob.empty() || blob.size() % kHeaderSize != 0) return false;
+             std::vector<uint8_t> buf(blob.begin(), blob.end());
+             Chain fresh(n.chain().difficulty_bits());
+             if (!Chain::load(buf, n.chain().difficulty_bits(), &fresh))
+               return false;
+             n.mutable_chain() = std::move(fresh);
+             return true;
+           })
+      .def("rollback",
+           [](Node& n, uint64_t new_height) {
+             n.mutable_chain().rollback_to(new_height);
+           })
+      .def("all_headers", [](const Node& n) {
+        // Headers for heights 1..tip (the adopt_chain wire format).
+        std::vector<py::bytes> out;
+        out.reserve(n.height());
+        uint8_t buf[kHeaderSize];
+        for (uint64_t h = 1; h <= n.height(); ++h) {
+          n.chain().at(h).header.serialize(buf);
+          out.push_back(to_bytes(buf, kHeaderSize));
+        }
+        return out;
+      });
+}
