@@ -171,13 +171,17 @@ def _proc_age_s(pid: int) -> float | None:
         return None
 
 
-def _kill_stale_chip_holders(min_age_s: float = 1800.0) -> list[int]:
+def _kill_stale_chip_holders(min_age_s: float = 1800.0,
+                             orphan_min_age_s: float = 300.0) -> list[int]:
     """The axon tunnel is effectively single-client: a leftover device
     process from an earlier run makes fresh init hang (round 1's failure
     mode). Kill python processes that carry our cmdline markers — but only
-    genuinely STALE ones (orphaned, or older than min_age_s), never
-    ourselves/our ancestors, and never a healthy concurrent run someone
-    just started."""
+    genuinely STALE ones, never ourselves/our ancestors, and never a
+    healthy concurrent run someone just started. "Stale" requires a
+    minimum age in EVERY case: older than min_age_s outright, or orphaned
+    (ppid==1 — routine reparenting in containers, so not proof of
+    staleness by itself) AND older than orphan_min_age_s. A process whose
+    age cannot be read is left alone."""
     me = os.getpid()
     ancestors = {me}
     pid = me
@@ -202,7 +206,9 @@ def _kill_stale_chip_holders(min_age_s: float = 1800.0) -> list[int]:
         if "python" not in cmd or not any(m in cmd for m in markers):
             continue
         age = _proc_age_s(int(p.name))
-        if ppid == 1 or (age is not None and age > min_age_s):
+        if age is None:
+            continue
+        if age > min_age_s or (ppid == 1 and age > orphan_min_age_s):
             victims.append(int(p.name))
     for pid in victims:
         try:

@@ -33,13 +33,23 @@ if _CHOICE not in ("auto", "pybind11", "ctypes"):
                      f"got {_CHOICE!r}")
 
 _pb = None
+BINDING_FALLBACK_REASON: str | None = None
 if _CHOICE in ("auto", "pybind11"):
     try:
         from .build import ensure_pybind_built
         _pb = ensure_pybind_built()
-    except Exception:
+    except Exception as e:
         if _CHOICE == "pybind11":
             raise
+        # auto mode falls back to ctypes, but never silently: a pybind
+        # build regression outside CI must stay visible (ADVICE round 2).
+        import warnings
+        BINDING_FALLBACK_REASON = f"{type(e).__name__}: {e}"
+        warnings.warn(
+            "chaincore pybind11 binding unavailable "
+            f"({BINDING_FALLBACK_REASON}); falling back to the ctypes "
+            "binding. Set MBT_BINDING=pybind11 to make this fatal.",
+            RuntimeWarning, stacklevel=2)
 
 if _pb is not None:
     BINDING = "pybind11"

@@ -43,34 +43,14 @@ void cc_header_midstate(const uint8_t header80[80], uint32_t out_state[8],
 
 // ---------- CPU nonce search (the cpu miner_backend) ----------
 
-// Sequential lowest-nonce-first sweep over [start_nonce, start_nonce+count),
-// clamped to the uint32 nonce space. Returns the first (== lowest) nonce
-// whose double-SHA256 header hash has >= difficulty_bits leading zero bits,
-// or UINT64_MAX if none in range. This "lowest qualifying nonce" rule is the
-// deterministic winner rule every backend implements, so CPU and TPU produce
-// identical block hashes (BASELINE.json north-star requirement).
+// Sequential lowest-nonce-first sweep; the shared chaincore::midstate_sweep
+// implements the deterministic "lowest qualifying nonce" winner rule
+// (BASELINE.json north-star requirement) for both bindings.
 uint64_t cc_search(const uint8_t header80[80], uint64_t start_nonce,
                    uint64_t count, uint32_t difficulty_bits,
                    uint64_t* hashes_tried) {
-  uint32_t midstate[8], tail[16];
-  header_midstate(header80, midstate, tail);
-  uint64_t end = start_nonce + count;
-  if (end > 0x100000000ULL) end = 0x100000000ULL;
-  uint64_t tried = 0;
-  for (uint64_t n = start_nonce; n < end; ++n, ++tried) {
-    // The header stores the nonce little-endian; SHA words are big-endian
-    // reads of the stream, so word 3 = bswap32(nonce).
-    tail[3] = ((uint32_t(n) & 0xff) << 24) | ((uint32_t(n) & 0xff00) << 8) |
-              ((uint32_t(n) >> 8) & 0xff00) | (uint32_t(n) >> 24);
-    uint8_t digest[32];
-    sha256d_from_midstate(midstate, tail, digest);
-    if (leading_zero_bits(digest) >= int(difficulty_bits)) {
-      if (hashes_tried) *hashes_tried = tried + 1;
-      return n;
-    }
-  }
-  if (hashes_tried) *hashes_tried = tried;
-  return UINT64_MAX;
+  return midstate_sweep(header80, start_nonce, count, difficulty_bits,
+                        hashes_tried);
 }
 
 // ---------- Node / Chain object API ----------

@@ -50,26 +50,17 @@ uint64_t checked_height(const Node& n, int64_t height) {
   return uint64_t(height);
 }
 
-// Sequential lowest-nonce sweep (same contract as capi.cpp cc_search).
-// GIL released: the CPU miner_backend runs this from 8 "rank" threads.
+// Sequential lowest-nonce sweep (same contract as capi.cpp cc_search; both
+// delegate to the shared chaincore::midstate_sweep). GIL released: the CPU
+// miner_backend runs this from 8 "rank" threads.
 std::pair<uint64_t, uint64_t> search_impl(const std::string& header80,
                                           uint64_t start_nonce,
                                           uint64_t count,
                                           uint32_t difficulty_bits) {
-  uint32_t midstate[8], tail[16];
-  header_midstate(data8(header80), midstate, tail);
-  uint64_t end = start_nonce + count;
-  if (end > 0x100000000ULL) end = 0x100000000ULL;
   uint64_t tried = 0;
-  for (uint64_t n = start_nonce; n < end; ++n, ++tried) {
-    tail[3] = ((uint32_t(n) & 0xff) << 24) | ((uint32_t(n) & 0xff00) << 8) |
-              ((uint32_t(n) >> 8) & 0xff00) | (uint32_t(n) >> 24);
-    uint8_t digest[32];
-    sha256d_from_midstate(midstate, tail, digest);
-    if (leading_zero_bits(digest) >= int(difficulty_bits))
-      return {n, tried + 1};
-  }
-  return {UINT64_MAX, tried};
+  uint64_t nonce = midstate_sweep(data8(header80), start_nonce, count,
+                                  difficulty_bits, &tried);
+  return {nonce, tried};
 }
 
 }  // namespace

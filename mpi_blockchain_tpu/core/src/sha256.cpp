@@ -128,6 +128,30 @@ void sha256d_from_midstate(const uint32_t midstate[8],
   for (int i = 0; i < 8; ++i) store_be32(out + 4 * i, st2[i]);
 }
 
+uint64_t midstate_sweep(const uint8_t header80[80], uint64_t start_nonce,
+                        uint64_t count, uint32_t difficulty_bits,
+                        uint64_t* hashes_tried) {
+  uint32_t midstate[8], tail[16];
+  header_midstate(header80, midstate, tail);
+  uint64_t end = start_nonce + count;
+  if (end > 0x100000000ULL) end = 0x100000000ULL;
+  uint64_t tried = 0;
+  for (uint64_t n = start_nonce; n < end; ++n, ++tried) {
+    // The header stores the nonce little-endian; SHA words are big-endian
+    // reads of the stream, so word 3 = bswap32(nonce).
+    tail[3] = ((uint32_t(n) & 0xff) << 24) | ((uint32_t(n) & 0xff00) << 8) |
+              ((uint32_t(n) >> 8) & 0xff00) | (uint32_t(n) >> 24);
+    uint8_t digest[32];
+    sha256d_from_midstate(midstate, tail, digest);
+    if (leading_zero_bits(digest) >= int(difficulty_bits)) {
+      if (hashes_tried) *hashes_tried = tried + 1;
+      return n;
+    }
+  }
+  if (hashes_tried) *hashes_tried = tried;
+  return UINT64_MAX;
+}
+
 int leading_zero_bits(const uint8_t h[32]) {
   int bits = 0;
   for (int i = 0; i < 32; ++i) {

@@ -48,4 +48,16 @@ void sha256d_from_midstate(const uint32_t midstate[8], const uint32_t tail_w[16]
 // big-endian integer (the proof-of-work difficulty measure).
 int leading_zero_bits(const uint8_t h[32]);
 
+// Sequential lowest-nonce-first midstate sweep over [start_nonce,
+// start_nonce + count), clamped to the uint32 nonce space. Returns the first
+// (== lowest) nonce whose double-SHA256 header hash has >= difficulty_bits
+// leading zero bits, or UINT64_MAX if none in range; *hashes_tried (if
+// non-null) receives the number of hashes evaluated. This "lowest qualifying
+// nonce" rule is the deterministic winner rule every backend implements, so
+// CPU and TPU produce identical block hashes. Shared by both Python bindings
+// (capi.cpp cc_search and pybind_module.cpp cpu_search).
+uint64_t midstate_sweep(const uint8_t header80[80], uint64_t start_nonce,
+                        uint64_t count, uint32_t difficulty_bits,
+                        uint64_t* hashes_tried);
+
 }  // namespace chaincore

@@ -64,6 +64,44 @@ def test_pybind_index_and_value_errors():
         n.submit(b"short")
 
 
+_FALLBACK_SNIPPET = """
+import importlib.util, pathlib, sys, types, warnings
+# Simulate a pybind build regression: core/__init__ does
+# `from .build import ensure_pybind_built`, which resolves through
+# sys.modules, so a pre-seeded fake module intercepts it. The ctypes
+# fallback's ensure_built stays real (loaded from the actual file).
+real_path = pathlib.Path("mpi_blockchain_tpu/core/build.py").resolve()
+spec = importlib.util.spec_from_file_location("_real_build", real_path)
+real = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(real)
+fake = types.ModuleType("mpi_blockchain_tpu.core.build")
+def ensure_pybind_built():
+    raise RuntimeError("simulated pybind build failure")
+fake.ensure_pybind_built = ensure_pybind_built
+fake.ensure_built = real.ensure_built
+sys.modules["mpi_blockchain_tpu.core.build"] = fake
+with warnings.catch_warnings(record=True) as caught:
+    warnings.simplefilter("always")
+    from mpi_blockchain_tpu import core
+assert core.BINDING == "ctypes", core.BINDING
+assert "simulated pybind build failure" in (core.BINDING_FALLBACK_REASON or "")
+msgs = [str(w.message) for w in caught if w.category is RuntimeWarning]
+assert any("falling back to the ctypes" in m for m in msgs), msgs
+print("FALLBACK_WARNED")
+"""
+
+
+def test_auto_fallback_warns_not_silent():
+    # ADVICE (round 2): a pybind build failure in auto mode must degrade
+    # to ctypes VISIBLY — RuntimeWarning + recorded reason, never silence.
+    env = dict(os.environ, MBT_BINDING="auto", PYTHONPATH=str(REPO))
+    proc = subprocess.run([sys.executable, "-c", _FALLBACK_SNIPPET],
+                          env=env, cwd=str(REPO), capture_output=True,
+                          text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr[-800:]
+    assert "FALLBACK_WARNED" in proc.stdout
+
+
 def test_bad_binding_choice_rejected():
     env = dict(os.environ, MBT_BINDING="nope", PYTHONPATH=str(REPO))
     proc = subprocess.run(
