@@ -1,0 +1,102 @@
+"""Roofline arithmetic for the Pallas sweep kernel (BASELINE.md §Utilization).
+
+Counts the VPU vector ops per nonce by tracing the production tile
+computation (ops/sha256_pallas.py:_tile_result) and counting jaxpr
+primitives whose output is the (ROWS, LANES) nonce tile — each such
+primitive is exactly one u32 ALU op per nonce. Scalar-core ops (uniform
+SMEM math) and trace-time numpy folds are excluded, mirroring what the
+VPU actually executes.
+
+Peak rate derivation (public numbers only):
+  * v5e peak bf16 matmul = 197 TFLOP/s with 4 MXUs of 128x128 MACs
+    (2 FLOPs each) => clock = 197e12 / (4*128*128*2) ~= 1.5 GHz.
+  * VPU = (8, 128) lanes x 4 independent ALUs per lane
+    => peak u32 rate = 8*128*4*1.5e9 ~= 6.1e12 ops/s.
+
+Usage: python experiments/roofline.py [measured_mhs]   (default 971.8)
+"""
+from __future__ import annotations
+
+import json
+import sys
+
+import jax
+
+# Tracing needs no accelerator; force CPU so the op census never touches
+# (or waits on) the axon tunnel. The config knob beats the site-hook that
+# re-forces JAX_PLATFORMS=axon.
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402,F401
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from mpi_blockchain_tpu.ops import sha256_pallas as sp  # noqa: E402
+
+TILE_SHAPE = (sp._ROWS, sp._LANES)
+
+# Arithmetic primitives that occupy a VPU ALU slot for one cycle per lane.
+_ALU_PRIMS = {
+    "add", "sub", "mul", "and", "or", "xor", "shift_left",
+    "shift_right_logical", "shift_right_arithmetic", "min", "max",
+    "select_n", "lt", "le", "gt", "ge", "eq", "ne", "not",
+}
+# Data-movement / materialization prims (iota, broadcast, convert,
+# bitcast): reported separately — they occupy issue slots but are not the
+# ALU work the roofline bounds.
+_MOVE_PRIMS = {"iota", "broadcast_in_dim", "convert_element_type",
+               "bitcast_convert_type", "reshape"}
+
+
+def count_tile_ops(difficulty_bits: int = 24) -> dict:
+    """Vector-op census of one production tile at the given difficulty."""
+    def tile(midstate, tail, base):
+        # jnp arrays support the same [i] scalar reads the kernel does on
+        # SMEM refs, so this traces the exact production code path.
+        return sp._tile_result(midstate, tail, base,
+                               difficulty_bits=difficulty_bits)
+
+    jaxpr = jax.make_jaxpr(tile)(
+        jnp.zeros((8,), jnp.uint32), jnp.zeros((16,), jnp.uint32),
+        jnp.uint32(0))
+
+    alu = move = scalar = reduce_ = other = 0
+    for eqn in jaxpr.jaxpr.eqns:
+        shapes = [getattr(v.aval, "shape", ()) for v in eqn.outvars]
+        name = eqn.primitive.name
+        if any(s == TILE_SHAPE for s in shapes):
+            if name in _ALU_PRIMS:
+                alu += 1
+            elif name in _MOVE_PRIMS:
+                move += 1
+            else:
+                other += 1
+        elif name in ("reduce_sum", "reduce_min", "reduce_max"):
+            reduce_ += 1
+        else:
+            scalar += 1
+    return {"alu_ops_per_nonce": alu, "move_ops_per_nonce": move,
+            "other_vector_ops": other, "reductions_per_tile": reduce_,
+            "scalar_ops_per_tile": scalar,
+            "tile_nonces": sp.TILE, "difficulty_bits": difficulty_bits}
+
+
+def roofline(measured_mhs: float = 971.8) -> dict:
+    census = count_tile_ops()
+    clock_hz = 197e12 / (4 * 128 * 128 * 2)          # ~1.5 GHz from MXU peak
+    vpu_peak = 8 * 128 * 4 * clock_hz                # lanes x ALUs x clock
+    alu = census["alu_ops_per_nonce"]
+    demand = measured_mhs * 1e6 * alu
+    return {
+        **census,
+        "measured_mhs": measured_mhs,
+        "v5e_clock_ghz": round(clock_hz / 1e9, 3),
+        "vpu_peak_u32_tops": round(vpu_peak / 1e12, 2),
+        "alu_demand_tops": round(demand / 1e12, 2),
+        "vpu_utilization_pct": round(100 * demand / vpu_peak, 1),
+    }
+
+
+if __name__ == "__main__":
+    mhs = float(sys.argv[1]) if len(sys.argv) > 1 else 971.8
+    print(json.dumps(roofline(mhs), indent=1))

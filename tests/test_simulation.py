@@ -138,6 +138,32 @@ def test_nonce_exhaustion_opens_fresh_search_space():
         net.run(target_height=1, max_steps=5, nonce_budget=1 << 8)
 
 
+def test_adversarial_with_tpu_backend_converges_and_matches_cpu():
+    """SimNodes running the device sweep behind the plugin boundary
+    (simulation.py backend dispatch): sim --backend tpu must converge AND
+    produce the byte-identical chain to the cpu-backend run — the plugin
+    contract (lowest qualifying nonce in [start, start+budget)) makes the
+    simulation's outcome backend-independent."""
+    # nonce_budget must stay well below 256/qualifier-probability: a budget
+    # that all-but-guarantees a find every step keeps both groups in
+    # lockstep (equal heights forever, keep-first never broken) — a
+    # parameter livelock, not a consensus bug. 256 ≈ 63% find rate.
+    kw = dict(partition_steps=6, target_height=3, nonce_budget=1 << 8)
+    tpu_cfg = MinerConfig(difficulty_bits=8, n_blocks=3, backend="tpu",
+                          kernel="jnp", batch_pow2=11, n_miners=2)
+    cpu_cfg = MinerConfig(difficulty_bits=8, n_blocks=3, backend="cpu")
+    tpu_net = run_adversarial(config=tpu_cfg, **kw)
+    cpu_net = run_adversarial(config=cpu_cfg, **kw)
+    assert tpu_net.converged() and cpu_net.converged()
+    # The sharded device path really ran (not a silent cpu fallback).
+    from mpi_blockchain_tpu.backend.tpu import TpuBackend
+    assert all(isinstance(n.backend, TpuBackend) for n in tpu_net.nodes)
+    assert all(n.backend._mesh_sweeper is not None for n in tpu_net.nodes)
+    assert [n.node.tip_hash for n in tpu_net.nodes] == \
+           [n.node.tip_hash for n in cpu_net.nodes]
+    assert tpu_net.step_count == cpu_net.step_count
+
+
 def test_flush_delivers_future_due_messages():
     # A message whose deliver_step lies past the current clock must land
     # when flushed with a horizon (the post-target flush path) — with
