@@ -61,6 +61,14 @@ if jax.default_backend() != "cpu":
         emit("chain", bench_chain(n_blocks=1000, difficulty_bits=24))
     except Exception as e:
         emit("chain_error", f"{type(e).__name__}: {e}")
+    # Config 4's exact production combination on hardware: shard_map +
+    # Pallas + psum/pmin on a 1-device ('miners',) mesh, tip checked
+    # against the C++ oracle (single measurement source in bench_lib).
+    try:
+        from mpi_blockchain_tpu.bench_lib import bench_sharded_pallas
+        emit("sharded_pallas", bench_sharded_pallas())
+    except Exception as e:
+        emit("sharded_pallas_error", f"{type(e).__name__}: {e}")
 """
 
 _PROBE_CODE = """
@@ -304,6 +312,16 @@ def main() -> int:
                                       + " [device child ran on cpu platform]")
         sweep = _cached("sweep")
         source = "cache" if sweep else "cpu-fallback"
+
+    if "sharded_pallas" in dev:
+        detail["sharded_pallas"] = dev["sharded_pallas"]
+        _cache_store("sharded_pallas", dev["sharded_pallas"])
+    elif "sharded_pallas_error" in dev:
+        detail["sharded_pallas"] = {"error": dev["sharded_pallas_error"]}
+    else:
+        cached_sp = _cached("sharded_pallas")
+        if cached_sp:
+            detail["sharded_pallas"] = cached_sp
 
     chain = dev.get("chain")
     if chain is not None:

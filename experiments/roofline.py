@@ -28,8 +28,9 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402,F401
+import pathlib  # noqa: E402
 
-sys.path.insert(0, __file__.rsplit("/", 2)[0])
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
 from mpi_blockchain_tpu.ops import sha256_pallas as sp  # noqa: E402
 

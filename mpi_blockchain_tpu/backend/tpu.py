@@ -1,15 +1,25 @@
-"""TPU miner_backend: jit'd batched nonce sweeps on one or more chips.
+"""TPU miner_backend: device-resident multi-round nonce search.
 
-Replaces the reference's per-rank scalar loop + MPI collectives with one jit'd
-XLA program per sweep round (SURVEY.md §3.4): the host sees only
-(count, min_nonce) per round; with n_miners > 1 the sweep runs under
-shard_map over the 'miners' mesh axis and the winner-select pmin/psum ride
-the ICI (parallel/mesh.py) — the TPU-native form of first-finder MPI_Bcast +
-height allreduce.
+Replaces the reference's per-rank scalar loop + MPI collectives with ONE
+jit'd XLA program per search (SURVEY.md §3.4 taken to the per-block limit):
+a ``lax.while_loop`` over ascending sweep rounds runs on-device until a
+round contains a qualifier, and with n_miners > 1 each round is shard_map'd
+over the 'miners' mesh with psum/pmin winner-select riding the ICI — the
+TPU-native form of first-finder MPI_Bcast + height allreduce.
 
-Early exit under jit: rounds cover contiguous ranges [base, base + R) from
-start_nonce upward, so the first round containing any qualifier yields the
-exact global lowest nonce — deterministic and backend-independent.
+Round-4 redesign: the previous per-ROUND host loop paid one host<->device
+round trip (~90 ms under the axon tunnel) per round, so at the config-3
+literal batch (2^20) the chip idled ~97% of the time (2.83 MH/s measured
+vs 971.8 at dispatch-amortized batches). Moving the round loop into the
+program makes a block cost ~one dispatch regardless of how many rounds the
+search needs; determinism is unchanged because rounds still ascend and the
+winner is still the lowest qualifying nonce in the requested range.
+
+Early exit under jit: rounds cover contiguous ranges from start_nonce
+upward, so the first round containing any qualifier yields the exact global
+lowest nonce — deterministic and backend-independent. The device cannot
+break mid-round, but a full round is exact-count work the host accounting
+mirrors (models/miner.py hashes_tried).
 """
 from __future__ import annotations
 
@@ -21,6 +31,29 @@ from . import MinerBackend, SearchResult, register
 NONCE_SPACE = 1 << 32
 
 
+def make_multiround_search_fn(batch_size: int, difficulty_bits: int,
+                              n_miners: int = 1, mesh=None,
+                              kernel: str = "auto"):
+    """Builds the jit'd multi-round searcher.
+
+    Returns (fn, effective_kernel) where
+    fn(midstate (8,)u32, tail (16,)u32, start u32, n_rounds u32)
+      -> (rounds_done u32, count i32, min_nonce u32)
+    sweeps rounds r = 0.. covering [start + r*round_size, +round_size)
+    until count > 0 or r == n_rounds (n_rounds is a traced scalar — no
+    recompile per call). count/min_nonce are the LAST executed round's
+    result; min_nonce is 0xFFFFFFFF when count == 0.
+    """
+    from ..ops import select_kernel
+    from ..parallel.mesh import make_round_search, maybe_shard_over_miners
+
+    sweep, effective = select_kernel(kernel, batch_size, difficulty_bits,
+                                     shard=True)
+    run = make_round_search(sweep, batch_size, batch_size * n_miners)
+    return maybe_shard_over_miners(run, n_miners, mesh,
+                                   n_in=4, n_out=3), effective
+
+
 @register("tpu")
 class TpuBackend(MinerBackend):
     def __init__(self, batch_pow2: int = 20, n_miners: int = 1,
@@ -30,25 +63,20 @@ class TpuBackend(MinerBackend):
         self.batch_size = 1 << batch_pow2
         self.n_miners = n_miners
         self.kernel = kernel
-        self._sweeps: dict[int, object] = {}  # difficulty -> compiled fn
-        if n_miners > 1:
-            from ..parallel.mesh import MeshSweeper
-            self._mesh_sweeper = MeshSweeper(n_miners=n_miners,
-                                             batch_size=self.batch_size,
-                                             kernel=kernel, mesh=mesh)
-        else:
-            self._mesh_sweeper = None
+        if n_miners > 1 and mesh is None:
+            from ..parallel.mesh import make_miner_mesh
+            mesh = make_miner_mesh(n_miners)
+        self.mesh = mesh
+        self._searchers: dict[int, object] = {}  # difficulty -> compiled fn
         self._jax = jax
 
-    # ---- kernel selection -------------------------------------------------
-
-    def _single_sweep(self, difficulty_bits: int):
-        fn = self._sweeps.get(difficulty_bits)
+    def _searcher(self, difficulty_bits: int):
+        fn = self._searchers.get(difficulty_bits)
         if fn is None:
-            from ..ops import select_kernel
-            fn, self.effective_kernel = select_kernel(
-                self.kernel, self.batch_size, difficulty_bits)
-            self._sweeps[difficulty_bits] = fn
+            fn, self.effective_kernel = make_multiround_search_fn(
+                self.batch_size, difficulty_bits, n_miners=self.n_miners,
+                mesh=self.mesh, kernel=self.kernel)
+            self._searchers[difficulty_bits] = fn
         return fn
 
     # ---- the plugin contract ---------------------------------------------
@@ -56,42 +84,51 @@ class TpuBackend(MinerBackend):
     def search(self, header80: bytes, difficulty_bits: int,
                start_nonce: int = 0, max_count: int = NONCE_SPACE
                ) -> SearchResult:
+        from ..parallel.mesh import replicated_host_values
+
         midstate, tail = core.header_midstate(header80)
         end = min(start_nonce + max_count, NONCE_SPACE)
         round_size = self.batch_size * self.n_miners
         tried = 0
         base = start_nonce
-        while base < end:
-            # The device sweeps full batches (static shapes). A final round
-            # that would wrap past 2^32 could surface a wrapped low nonce
-            # from *unswept* space and shadow a genuine in-range winner, so
-            # that partial tail (< round_size nonces) runs on the CPU oracle
-            # instead.
-            if base + round_size > NONCE_SPACE:
-                nonce, t = core.cpu_search(header80, base, end - base,
-                                           difficulty_bits)
-                tried += t
-                if nonce is not None:
-                    winner = core.set_nonce(header80, nonce)
-                    return SearchResult(nonce, core.header_hash(winner),
-                                        tried)
-                break
-            if self._mesh_sweeper is not None:
-                count, min_nonce = self._mesh_sweeper.sweep(
-                    midstate, tail, base, difficulty_bits)
-            else:
-                fn = self._single_sweep(difficulty_bits)
-                count, min_nonce = fn(midstate, tail,
-                                      np.uint32(base))
-            count = int(count)
-            min_nonce = int(min_nonce)
-            tried += min(round_size, end - base)
+        # The device sweeps full rounds (static shapes). Rounds are capped
+        # to those fully inside the uint32 nonce space: a round wrapping
+        # past 2^32 could surface a wrapped low nonce from *unswept* space
+        # and shadow a genuine in-range winner, so any partial tail
+        # (< round_size nonces) runs on the CPU oracle after the device
+        # rounds.
+        n_rounds = 0
+        if base < end and base + round_size <= NONCE_SPACE:
+            # The 0xFFFFFFFF clamp keeps np.uint32(n_rounds) in range at
+            # round_size == 1 (n_rounds would be 2^32); the one elided
+            # round falls through to the CPU tail below.
+            n_rounds = min(-(-(end - base) // round_size),
+                           (NONCE_SPACE - base) // round_size, 0xFFFFFFFF)
+        if n_rounds > 0:
+            out = self._searcher(difficulty_bits)(
+                midstate, tail, np.uint32(base), np.uint32(n_rounds))
+            rounds, count, min_nonce = (
+                int(v) for v in replicated_host_values(out))
+            if rounds > 0:
+                # Same accounting as one host-checked round at a time:
+                # every executed round counts in full, except the final
+                # round's overshoot past the requested end.
+                last_base = base + (rounds - 1) * round_size
+                tried += (rounds - 1) * round_size \
+                    + min(round_size, end - last_base)
             # min_nonce >= end can only be an overshoot past the requested
-            # range (never a wrap: wrapping rounds were handled above).
+            # range (never a wrap: wrapping rounds were excluded above) —
+            # and then no later round could hold an in-range winner either.
             if count > 0 and base <= min_nonce < end:
                 winner = core.set_nonce(header80, min_nonce)
-                return SearchResult(min_nonce, core.header_hash(winner), tried)
-            base += round_size
+                return SearchResult(min_nonce, core.header_hash(winner),
+                                    tried)
+            base += rounds * round_size
+        if base < end:
+            nonce, t = core.cpu_search(header80, base, end - base,
+                                       difficulty_bits)
+            tried += t
+            if nonce is not None:
+                winner = core.set_nonce(header80, nonce)
+                return SearchResult(nonce, core.header_hash(winner), tried)
         return SearchResult(None, None, tried)
-
-

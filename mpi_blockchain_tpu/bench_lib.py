@@ -103,12 +103,13 @@ def bench_tpu(seconds: float = 5.0, batch_pow2: int = 28,
 
 def bench_chain(n_blocks: int = 1000, difficulty_bits: int = 24,
                 batch_pow2: int = 24, blocks_per_call: int = 100,
-                n_miners: int = 1, kernel: str = "auto") -> dict:
+                n_miners: int = 1, kernel: str = "auto",
+                mesh=None) -> dict:
     """Wall-clock to mine a full chain — the metric's second half.
 
     Uses the fused device-resident miner (models/fused.py) and validates
-    the resulting chain before reporting. n_miners > 1 runs the sharded
-    mine loop over the ('miners',) mesh.
+    the resulting chain before reporting. n_miners > 1 (or an explicit
+    mesh) runs the sharded mine loop over the ('miners',) mesh.
     """
     import time as _time
 
@@ -118,7 +119,7 @@ def bench_chain(n_blocks: int = 1000, difficulty_bits: int = 24,
     cfg = MinerConfig(difficulty_bits=difficulty_bits, n_blocks=n_blocks,
                       batch_pow2=batch_pow2, backend="tpu",
                       n_miners=n_miners, kernel=kernel)
-    miner = FusedMiner(cfg, blocks_per_call=blocks_per_call)
+    miner = FusedMiner(cfg, blocks_per_call=blocks_per_call, mesh=mesh)
     miner.warmup()
     if n_blocks % blocks_per_call:    # the remainder chunk is its own program
         miner.warmup(n_blocks % blocks_per_call)
@@ -135,6 +136,35 @@ def bench_chain(n_blocks: int = 1000, difficulty_bits: int = 24,
             "n_miners": n_miners, "wall_s": round(wall, 3),
             "blocks_per_sec": n_blocks / wall,
             "tip_hash": node.tip_hash.hex()}
+
+
+def bench_sharded_pallas(n_blocks: int = 30, difficulty_bits: int = 16,
+                         batch_pow2: int = 20,
+                         blocks_per_call: int = 10) -> dict:
+    """Config 4's exact production combination, proven on ONE chip: the
+    fused miner through the shard_map branch (psum/pmin winner-select)
+    with the Pallas kernel on a 1-device ('miners',) mesh, tip checked
+    against the C++ oracle. The single source of this measurement —
+    bench.py's device child and experiments/hw_round4.py both call it;
+    the warmup/timing discipline lives in bench_chain.
+    """
+    from .config import MinerConfig
+    from .models.miner import Miner
+    from .parallel.mesh import make_miner_mesh
+
+    result = bench_chain(n_blocks=n_blocks, difficulty_bits=difficulty_bits,
+                         batch_pow2=batch_pow2,
+                         blocks_per_call=blocks_per_call, n_miners=1,
+                         kernel="pallas", mesh=make_miner_mesh(1))
+    oracle = Miner(MinerConfig(difficulty_bits=difficulty_bits,
+                               n_blocks=n_blocks, backend="cpu"),
+                   log_fn=lambda d: None)
+    oracle.mine_chain()
+    return {**result, "mesh": "1-device ('miners',) on real TPU",
+            "kernel": "pallas",
+            "cpu_oracle_tip": oracle.node.tip_hash.hex(),
+            "tip_matches_cpu_oracle":
+                result["tip_hash"] == oracle.node.tip_hash.hex()}
 
 
 def run_bench(backend: str = "tpu", seconds: float = 5.0,

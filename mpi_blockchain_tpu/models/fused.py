@@ -25,16 +25,13 @@ which is the end state of the reference's MPI -> ICI substitution.
 """
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import PartitionSpec as P
 
 from .. import core
 from ..config import ConfigError
-from ..ops.sha256_jnp import (IV, NOT_FOUND_U32, _bswap32, compress,
+from ..ops.sha256_jnp import (IV, _bswap32, compress,
                               sha256d_words_from_midstate)
 from ..parallel.mesh import replicated_host_value
 
@@ -60,15 +57,17 @@ def make_fused_miner(k_blocks: int, batch_pow2: int, difficulty_bits: int,
     """
     batch = 1 << batch_pow2
     round_size = batch * n_miners
-    n_rounds_cap = (max_rounds if max_rounds is not None
-                    else (1 << 32) // round_size)
+    n_rounds_cap = min(max_rounds if max_rounds is not None
+                       else (1 << 32) // round_size, 0xFFFFFFFF)
 
     from ..ops import select_kernel
+    from ..parallel.mesh import make_round_search
     # The mine loop only consumes (count > 0, min_nonce), so the sweep can
     # skip tiles past the first qualifier — at diff d with batch ~2^d this
     # cuts expected hashes per block from ~1.58*2^d to ~2^d.
     sweep, _ = select_kernel(kernel, batch, difficulty_bits, shard=True,
                              early_exit=True)
+    round_search = make_round_search(sweep, batch, round_size)
 
     bits_word = _bswap32(np.uint32(difficulty_bits))
 
@@ -85,26 +84,8 @@ def make_fused_miner(k_blocks: int, batch_pow2: int, difficulty_bits: int,
              jnp.zeros((), _U32), jnp.asarray(np.uint32(0x80000000))]
             + [jnp.zeros((), _U32)] * 10 + [jnp.asarray(np.uint32(640))])
 
-        def cond(state):
-            rounds, count, _ = state
-            return (count == 0) & (rounds < n_rounds_cap)
-
-        def body(state):
-            rounds, _, _ = state
-            base = (rounds * np.uint32(round_size)).astype(_U32)
-            if axis_name is not None:
-                i = jax.lax.axis_index(axis_name).astype(_U32)
-                local_base = base + i * np.uint32(batch)
-                c, mn = sweep(midstate, tail, local_base)
-                c = jax.lax.psum(c, axis_name)
-                mn = jax.lax.pmin(mn, axis_name)
-            else:
-                c, mn = sweep(midstate, tail, base)
-            return rounds + np.uint32(1), c, mn
-
-        _, _, nonce = jax.lax.while_loop(
-            cond, body, (np.uint32(0), jnp.zeros((), jnp.int32),
-                         jnp.asarray(NOT_FOUND_U32)))
+        _, _, nonce = round_search(midstate, tail, np.uint32(0),
+                                   np.uint32(n_rounds_cap), axis_name)
         # Digest of the winning header = next prev_hash words.
         digest = jnp.stack(sha256d_words_from_midstate(
             midstate, tail, _bswap32(nonce)))
@@ -123,15 +104,8 @@ def make_fused_miner(k_blocks: int, batch_pow2: int, difficulty_bits: int,
             (prev_words, jnp.zeros((k_blocks,), _U32)))
         return nonces, tip
 
-    if n_miners > 1:
-        from ..parallel.mesh import make_miner_mesh
-        if mesh is None:
-            mesh = make_miner_mesh(n_miners)
-        sharded = jax.shard_map(
-            functools.partial(mine_k, axis_name="miners"),
-            mesh=mesh, in_specs=(P(), P(), P()), out_specs=(P(), P()))
-        return jax.jit(sharded)
-    return jax.jit(functools.partial(mine_k, axis_name=None))
+    from ..parallel.mesh import maybe_shard_over_miners
+    return maybe_shard_over_miners(mine_k, n_miners, mesh, n_in=3, n_out=2)
 
 
 class FusedMiner:

@@ -5,4 +5,5 @@ The TPU-native replacement for the reference's OpenMPI backend
 pmin winner-select inside the sharded sweep, height allreduce becomes a psum
 — both ride the ICI, with no cross-process boundary on a single host.
 """
-from .mesh import MeshSweeper, make_miner_mesh  # noqa: F401
+from .mesh import (make_mesh_sweep_fn, make_miner_mesh,  # noqa: F401
+                   sharded_local_base, winner_select)

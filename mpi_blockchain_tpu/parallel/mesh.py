@@ -38,6 +38,20 @@ def replicated_host_value(x) -> np.ndarray:
     return np.asarray(x.addressable_data(0))
 
 
+def replicated_host_values(xs) -> tuple:
+    """Batched replicated_host_value: starts every D2H copy before blocking
+    on any — one tunnel round trip for all outputs instead of one each
+    (the axon tunnel bills ~90 ms per blocking transfer)."""
+    xs = tuple(xs)
+    for x in xs:
+        try:
+            (x if getattr(x, "is_fully_addressable", True)
+             else x.addressable_data(0)).copy_to_host_async()
+        except AttributeError:
+            pass
+    return tuple(replicated_host_value(x) for x in xs)
+
+
 def make_miner_mesh(n_miners: int) -> Mesh:
     """A 1-D ('miners',) mesh over the first n_miners local devices."""
     devices = jax.devices()
@@ -48,6 +62,96 @@ def make_miner_mesh(n_miners: int) -> Mesh:
             f"--xla_force_host_platform_device_count={n_miners})")
     return jax.make_mesh((n_miners,), ("miners",),
                          devices=devices[:n_miners])
+
+
+def maybe_shard_over_miners(fn, n_miners: int, mesh: Mesh | None,
+                            n_in: int, n_out: int):
+    """jit-wraps a device program, shard_map'd over ('miners',) when
+    n_miners > 1 OR an explicit mesh is passed — 1-element-axis collectives
+    compile the same program, which is how the production sharded path gets
+    hardware-proven on a single chip (bench.py sharded_pallas section).
+    fn must accept a keyword-only/last arg axis_name (None = unsharded);
+    all n_in inputs and n_out outputs are replicated."""
+    import functools
+    if n_miners > 1 or mesh is not None:
+        if mesh is None:
+            mesh = make_miner_mesh(n_miners)
+        elif mesh.size != max(n_miners, 1):
+            # A mismatch would leave per-round slices [n_devices*batch,
+            # n_miners*batch) silently unswept — breaking the lowest-nonce
+            # determinism contract. Fail at build time instead.
+            raise ConfigError(
+                f"mesh has {mesh.size} devices but n_miners={n_miners}; "
+                f"the 'miners' axis must match the round split exactly")
+        sharded = jax.shard_map(functools.partial(fn, axis_name="miners"),
+                                mesh=mesh, in_specs=(P(),) * n_in,
+                                out_specs=(P(),) * n_out)
+        return jax.jit(sharded)
+    return jax.jit(functools.partial(fn, axis_name=None))
+
+
+def make_round_search(sweep, batch_size: int, round_size: int):
+    """The multi-round device search loop, shared by the per-block searcher
+    (backend/tpu.py) and the fused miner (models/fused.py).
+
+    Returns run(midstate (8,)u32, tail_w (16,)u32, start u32, n_rounds u32,
+    axis_name=None) -> (rounds_done u32, count i32, min_nonce u32): a
+    lax.while_loop over ascending rounds r covering [start + r*round_size,
+    +round_size) that exits at the first round containing a qualifier.
+    count/min_nonce are the LAST executed round's result (min_nonce ==
+    0xFFFFFFFF when count == 0); rounds ascend, so the winner is the exact
+    global lowest qualifying nonce — the determinism contract. n_rounds is
+    a traced scalar: one compile serves any round budget.
+    """
+    # round_size == 2^32 (one round = the whole nonce space) is a legal
+    # config whose multiplier overflows uint32; masked it becomes 0, which
+    # stays correct because the only executable round is then r == 0.
+    round_size_u32 = np.uint32(round_size & 0xFFFFFFFF)
+
+    def run(midstate, tail_w, start, n_rounds, axis_name=None):
+        def cond(s):
+            r, c, _ = s
+            return (c == 0) & (r < n_rounds)
+
+        def body(s):
+            r, _, _ = s
+            base = (jnp.asarray(start).astype(_U32) + r * round_size_u32)
+            if axis_name is not None:
+                c, mn = sweep(midstate, tail_w,
+                              sharded_local_base(base, batch_size,
+                                                 axis_name))
+                c, mn = winner_select(c, mn, axis_name)
+            else:
+                c, mn = sweep(midstate, tail_w, base)
+            return r + np.uint32(1), c, mn
+
+        from ..ops.sha256_jnp import NOT_FOUND_U32
+        return jax.lax.while_loop(
+            cond, body, (np.uint32(0), jnp.zeros((), jnp.int32),
+                         jnp.asarray(NOT_FOUND_U32)))
+
+    return run
+
+
+def sharded_local_base(base, batch_size: int, axis_name: str = "miners"):
+    """This device's slice offset of a round's contiguous global range:
+    round r covers [base, base + n_miners*batch_size); device i sweeps
+    [base + i*batch_size, +batch_size)."""
+    i = jax.lax.axis_index(axis_name).astype(_U32)
+    return jnp.asarray(base).astype(_U32) + i * np.uint32(batch_size)
+
+
+def winner_select(count, min_nonce, axis_name: str = "miners"):
+    """The reference's MPI_Bcast/allreduce as ICI collectives: psum the
+    qualifier count, pmin the per-device min qualifying nonce (0xFFFFFFFF
+    where none), replicated to every device — the pmin result arriving on
+    all devices *is* the first-finder broadcast. Deterministic winner =
+    lowest nonce; ties impossible (disjoint ranges), so no device-id
+    tiebreak is needed. The ONE copy of the winner-select epilogue, shared
+    by the per-round mesh sweep, the multi-round searcher (backend/tpu.py),
+    and the fused miner (models/fused.py)."""
+    return (jax.lax.psum(count, axis_name),
+            jax.lax.pmin(min_nonce, axis_name))
 
 
 def make_mesh_sweep_fn(mesh: Mesh, batch_size: int, difficulty_bits: int,
@@ -63,39 +167,10 @@ def make_mesh_sweep_fn(mesh: Mesh, batch_size: int, difficulty_bits: int,
     sweep, _ = select_kernel(kernel, batch_size, difficulty_bits, shard=True)
 
     def per_device(midstate, tail_w, base):
-        i = jax.lax.axis_index("miners").astype(_U32)
-        local_base = jnp.asarray(base).astype(_U32) + i * np.uint32(batch_size)
-        count, min_nonce = sweep(midstate, tail_w, local_base)
-        # Winner-select: the reference's MPI_Bcast/allreduce, as ICI
-        # collectives. min_nonce is 0xFFFFFFFF where count==0, so pmin
-        # directly yields the global lowest qualifying nonce.
-        total = jax.lax.psum(count, "miners")
-        gmin = jax.lax.pmin(min_nonce, "miners")
-        return total, gmin
+        count, min_nonce = sweep(midstate, tail_w,
+                                 sharded_local_base(base, batch_size))
+        return winner_select(count, min_nonce)
 
     sharded = jax.shard_map(per_device, mesh=mesh,
                             in_specs=(P(), P(), P()), out_specs=(P(), P()))
     return jax.jit(sharded)
-
-
-class MeshSweeper:
-    """Per-difficulty cache of jit'd sharded sweeps over one miners mesh."""
-
-    def __init__(self, n_miners: int, batch_size: int, kernel: str = "auto",
-                 mesh: Mesh | None = None):
-        self.mesh = mesh if mesh is not None else make_miner_mesh(n_miners)
-        self.n_miners = n_miners
-        self.batch_size = batch_size
-        self.kernel = kernel
-        self._fns: dict[int, object] = {}
-
-    def sweep(self, midstate, tail_w, base: int, difficulty_bits: int):
-        fn = self._fns.get(difficulty_bits)
-        if fn is None:
-            fn = make_mesh_sweep_fn(self.mesh, self.batch_size,
-                                    difficulty_bits, self.kernel)
-            self._fns[difficulty_bits] = fn
-        count, gmin = fn(jnp.asarray(midstate), jnp.asarray(tail_w),
-                         np.uint32(base))
-        return (int(replicated_host_value(count)),
-                int(replicated_host_value(gmin)))

@@ -28,6 +28,19 @@ def test_fused_identical_chain(oracle_chain, n_miners, batch_pow2):
     assert fm.chain_hashes() == oracle_chain.chain_hashes()
 
 
+def test_fused_explicit_mesh_forces_sharded_branch(oracle_chain):
+    """An explicit 1-device mesh opts into the shard_map program (the
+    single-chip hardware proof path for config 4): psum/pmin over the
+    1-element 'miners' axis must not change the chain."""
+    from mpi_blockchain_tpu.parallel.mesh import make_miner_mesh
+
+    cfg = MinerConfig(difficulty_bits=DIFF, n_blocks=6, batch_pow2=12,
+                      n_miners=1, backend="tpu", kernel="jnp")
+    fm = FusedMiner(cfg, blocks_per_call=3, mesh=make_miner_mesh(1))
+    fm.mine_chain()
+    assert fm.chain_hashes() == oracle_chain.chain_hashes()
+
+
 def test_fused_multiple_calls_resume(oracle_chain):
     """Chain continues correctly across separate mine_chain calls."""
     cfg = MinerConfig(difficulty_bits=DIFF, n_blocks=6, batch_pow2=12,

@@ -158,7 +158,8 @@ def test_adversarial_with_tpu_backend_converges_and_matches_cpu():
     # The sharded device path really ran (not a silent cpu fallback).
     from mpi_blockchain_tpu.backend.tpu import TpuBackend
     assert all(isinstance(n.backend, TpuBackend) for n in tpu_net.nodes)
-    assert all(n.backend._mesh_sweeper is not None for n in tpu_net.nodes)
+    assert all(n.backend.mesh is not None and n.backend.n_miners == 2
+               for n in tpu_net.nodes)
     assert [n.node.tip_hash for n in tpu_net.nodes] == \
            [n.node.tip_hash for n in cpu_net.nodes]
     assert tpu_net.step_count == cpu_net.step_count
