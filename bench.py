@@ -69,6 +69,13 @@ if jax.default_backend() != "cpu":
         emit("sharded_pallas", bench_sharded_pallas())
     except Exception as e:
         emit("sharded_pallas_error", f"{type(e).__name__}: {e}")
+    # Config 3's literal preset through the round-4 multi-round searcher
+    # (the dispatch-latency regression record; was 2.83 MH/s in round 1).
+    try:
+        from mpi_blockchain_tpu.bench_lib import bench_tpu_single
+        emit("tpu_single", bench_tpu_single())
+    except Exception as e:
+        emit("tpu_single_error", f"{type(e).__name__}: {e}")
 """
 
 _PROBE_CODE = """
@@ -313,15 +320,16 @@ def main() -> int:
         sweep = _cached("sweep")
         source = "cache" if sweep else "cpu-fallback"
 
-    if "sharded_pallas" in dev:
-        detail["sharded_pallas"] = dev["sharded_pallas"]
-        _cache_store("sharded_pallas", dev["sharded_pallas"])
-    elif "sharded_pallas_error" in dev:
-        detail["sharded_pallas"] = {"error": dev["sharded_pallas_error"]}
-    else:
-        cached_sp = _cached("sharded_pallas")
-        if cached_sp:
-            detail["sharded_pallas"] = cached_sp
+    for section in ("sharded_pallas", "tpu_single"):
+        if section in dev:
+            detail[section] = dev[section]
+            _cache_store(section, dev[section])
+        elif f"{section}_error" in dev:
+            detail[section] = {"error": dev[f"{section}_error"]}
+        else:
+            cached_val = _cached(section)
+            if cached_val:
+                detail[section] = cached_val
 
     chain = dev.get("chain")
     if chain is not None:

@@ -38,35 +38,14 @@ def main():
     emit("platform", jax.default_backend())
 
     from mpi_blockchain_tpu import core
-    from mpi_blockchain_tpu.config import PRESETS, MinerConfig
+    from mpi_blockchain_tpu.config import MinerConfig
     from mpi_blockchain_tpu.models.fused import FusedMiner
-    from mpi_blockchain_tpu.models.miner import Miner
     from mpi_blockchain_tpu.ops import sha256_pallas as sp
     from mpi_blockchain_tpu.parallel.mesh import make_miner_mesh
 
     # ---- 1. config-3 literal preset through the multi-round searcher ----
-    cfg = PRESETS["tpu-single"]
-    miner = Miner(cfg, log_fn=lambda d: None)
-    # Compile outside the timer (jit is lazy, so a throwaway one-round
-    # search is what actually triggers Mosaic), exactly like the round-1
-    # measurement this is compared against.
-    miner.backend.search(bytes(80), cfg.difficulty_bits,
-                         max_count=cfg.batch_size)
-    t0 = time.perf_counter()
-    miner.mine_chain()
-    wall = time.perf_counter() - t0
-    oracle = Miner(MinerConfig(difficulty_bits=cfg.difficulty_bits,
-                               n_blocks=cfg.n_blocks, backend="cpu"),
-                   log_fn=lambda d: None)
-    oracle.mine_chain()
-    emit("tpu_single_preset", {
-        "wall_s": round(wall, 2),
-        "hashes_per_sec": round(miner.hashes_per_sec()),
-        "mhs": round(miner.hashes_per_sec() / 1e6, 2),
-        "vs_round1_2p83": round(miner.hashes_per_sec() / 2.83e6, 1),
-        "tip_hash": miner.node.tip_hash.hex(),
-        "tip_matches_cpu_oracle":
-            miner.node.tip_hash == oracle.node.tip_hash})
+    from mpi_blockchain_tpu.bench_lib import bench_tpu_single
+    emit("tpu_single_preset", bench_tpu_single())
 
     # ---- 2. while-impl early exit: correctness then chain bench ---------
     hdr = bytes(range(80))

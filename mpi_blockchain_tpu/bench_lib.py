@@ -167,6 +167,43 @@ def bench_sharded_pallas(n_blocks: int = 30, difficulty_bits: int = 16,
                 result["tip_hash"] == oracle.node.tip_hash.hex()}
 
 
+def bench_tpu_single() -> dict:
+    """Config 3's LITERAL preset (difficulty 20, 10 blocks, batch 2^20,
+    pallas) through the per-block multi-round searcher, tip checked against
+    the CPU oracle. This is the dispatch-latency regression record: the
+    round-1 per-round host loop measured 8.2 s / 2.83 MH/s here; the
+    round-4 on-device round loop costs ~one dispatch per block. The single
+    measurement source — bench.py's device child and
+    experiments/hw_round4.py §1 both call it.
+    """
+    from .config import PRESETS, MinerConfig
+    from .models.miner import Miner
+
+    cfg = PRESETS["tpu-single"]
+    miner = Miner(cfg, log_fn=lambda d: None)
+    # Compile outside the timer (jit is lazy: only a real search call
+    # triggers Mosaic), mirroring the round-1 measurement's discipline.
+    miner.backend.search(bytes(80), cfg.difficulty_bits,
+                         max_count=cfg.batch_size)
+    t0 = time.perf_counter()
+    miner.mine_chain()
+    wall = time.perf_counter() - t0
+    oracle = Miner(MinerConfig(difficulty_bits=cfg.difficulty_bits,
+                               n_blocks=cfg.n_blocks, backend="cpu"),
+                   log_fn=lambda d: None)
+    oracle.mine_chain()
+    return {"preset": "tpu-single", "n_blocks": cfg.n_blocks,
+            "difficulty_bits": cfg.difficulty_bits,
+            "batch_pow2": cfg.batch_pow2, "wall_s": round(wall, 2),
+            "hashes_per_sec": round(miner.hashes_per_sec()),
+            "mhs": round(miner.hashes_per_sec() / 1e6, 2),
+            "vs_round1_2p83_mhs": round(
+                miner.hashes_per_sec() / 2.83e6, 1),
+            "tip_hash": miner.node.tip_hash.hex(),
+            "tip_matches_cpu_oracle":
+                miner.node.tip_hash == oracle.node.tip_hash}
+
+
 def run_bench(backend: str = "tpu", seconds: float = 5.0,
               batch_pow2: int = 28, n_miners: int = 1,
               kernel: str = "auto") -> dict:
