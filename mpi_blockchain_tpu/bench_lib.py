@@ -139,14 +139,16 @@ def bench_chain(n_blocks: int = 1000, difficulty_bits: int = 24,
 
 
 def bench_sharded_pallas(n_blocks: int = 30, difficulty_bits: int = 16,
-                         batch_pow2: int = 20,
-                         blocks_per_call: int = 10) -> dict:
+                         batch_pow2: int = 20, blocks_per_call: int = 10,
+                         kernel: str = "pallas") -> dict:
     """Config 4's exact production combination, proven on ONE chip: the
     fused miner through the shard_map branch (psum/pmin winner-select)
     with the Pallas kernel on a 1-device ('miners',) mesh, tip checked
     against the C++ oracle. The single source of this measurement —
     bench.py's device child and experiments/hw_round4.py both call it;
-    the warmup/timing discipline lives in bench_chain.
+    the warmup/timing discipline lives in bench_chain. kernel is
+    overridable only so the CI suite can run the identical code path with
+    the jnp kernel on the CPU platform (tests/test_fused.py).
     """
     from .config import MinerConfig
     from .models.miner import Miner
@@ -155,13 +157,13 @@ def bench_sharded_pallas(n_blocks: int = 30, difficulty_bits: int = 16,
     result = bench_chain(n_blocks=n_blocks, difficulty_bits=difficulty_bits,
                          batch_pow2=batch_pow2,
                          blocks_per_call=blocks_per_call, n_miners=1,
-                         kernel="pallas", mesh=make_miner_mesh(1))
+                         kernel=kernel, mesh=make_miner_mesh(1))
     oracle = Miner(MinerConfig(difficulty_bits=difficulty_bits,
                                n_blocks=n_blocks, backend="cpu"),
                    log_fn=lambda d: None)
     oracle.mine_chain()
-    return {**result, "mesh": "1-device ('miners',) on real TPU",
-            "kernel": "pallas",
+    return {**result, "mesh": "1-device ('miners',)",
+            "kernel": kernel,
             "cpu_oracle_tip": oracle.node.tip_hash.hex(),
             "tip_matches_cpu_oracle":
                 result["tip_hash"] == oracle.node.tip_hash.hex()}

@@ -41,6 +41,20 @@ def test_fused_explicit_mesh_forces_sharded_branch(oracle_chain):
     assert fm.chain_hashes() == oracle_chain.chain_hashes()
 
 
+def test_bench_sharded_pallas_path_with_jnp_kernel():
+    """The exact bench.py sharded_pallas measurement path (fused miner on
+    an explicit 1-device mesh + CPU-oracle tip check), with the kernel
+    pinned to jnp so it runs in CI; on hardware the same function runs
+    with the pallas kernel."""
+    from mpi_blockchain_tpu.bench_lib import bench_sharded_pallas
+
+    out = bench_sharded_pallas(n_blocks=4, difficulty_bits=8,
+                               batch_pow2=10, blocks_per_call=2,
+                               kernel="jnp")
+    assert out["tip_matches_cpu_oracle"] is True
+    assert out["n_blocks"] == 4 and out["kernel"] == "jnp"
+
+
 def test_fused_multiple_calls_resume(oracle_chain):
     """Chain continues correctly across separate mine_chain calls."""
     cfg = MinerConfig(difficulty_bits=DIFF, n_blocks=6, batch_pow2=12,
