@@ -16,7 +16,6 @@ from __future__ import annotations
 
 import jax
 
-from .mesh import make_miner_mesh
 
 
 def init_distributed(coordinator_address: str | None = None,

@@ -5,7 +5,6 @@ random headers, every backend returns the same lowest qualifying nonce and
 hence the same block hash. Runs on the CPU JAX platform (conftest), which
 exercises the identical uint32 code path XLA compiles for TPU.
 """
-import os
 import random
 
 import numpy as np
@@ -13,7 +12,7 @@ import pytest
 
 from mpi_blockchain_tpu import core
 from mpi_blockchain_tpu.backend import get_backend
-from mpi_blockchain_tpu.ops.sha256_jnp import make_sweep_fn, sweep_jnp
+from mpi_blockchain_tpu.ops.sha256_jnp import make_sweep_fn
 
 rng = random.Random(1234)
 

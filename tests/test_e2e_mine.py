@@ -5,7 +5,6 @@ on the CPU JAX platform) and asserts identical block hashes — BASELINE
 config 1 merged with config 3 at reduced difficulty, plus the mesh variant
 of config 4.
 """
-import pytest
 
 from mpi_blockchain_tpu.config import MinerConfig, PRESETS
 from mpi_blockchain_tpu.models.miner import Miner

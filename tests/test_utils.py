@@ -1,7 +1,6 @@
 """Checkpoint/resume + distributed-module shape tests."""
 import pytest
 
-from mpi_blockchain_tpu import core
 from mpi_blockchain_tpu.config import MinerConfig
 from mpi_blockchain_tpu.models.miner import Miner
 from mpi_blockchain_tpu.utils.checkpoint import load_chain, save_chain
