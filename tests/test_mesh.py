@@ -60,6 +60,17 @@ def test_mesh_backend_identical_hashes():
         assert r_cpu.hash == r_mesh.hash
 
 
+def test_mesh_size_mismatch_rejected():
+    """A mesh whose device count disagrees with n_miners would leave
+    per-round nonce slices silently unswept; the build must fail loud."""
+    from mpi_blockchain_tpu.backend.tpu import make_multiround_search_fn
+    from mpi_blockchain_tpu.config import ConfigError
+
+    with pytest.raises(ConfigError, match="mesh has 2 devices"):
+        make_multiround_search_fn(1 << 10, 8, n_miners=4,
+                                  mesh=make_miner_mesh(2), kernel="jnp")
+
+
 def test_multiround_full_space_round_builds():
     """round_size == 2^32 (one round = whole nonce space) must not
     overflow the uint32 round multiplier at build or trace time."""
