@@ -148,6 +148,14 @@ def test_cli_bench_chain_mode(capsys):
     assert out["wall_s"] > 0
 
 
+def test_cli_bench_sweep_mode_cpu(capsys):
+    rc = main(["bench", "--backend", "cpu", "--seconds", "0.2"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert out["backend"] == "cpu" and out["hashes_per_sec"] > 0
+    assert out["hashes"] > 0
+
+
 def test_cli_profile_flag(tmp_path, capsys):
     trace_dir = tmp_path / "trace"
     rc = main(["mine", "--difficulty", "6", "--blocks", "1", "--backend",
