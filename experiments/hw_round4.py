@@ -1,7 +1,8 @@
 """Round-4 hardware validation session (real TPU via the axon tunnel).
 
 One process, three items, each emitting a JSON line the moment it is
-measured (hang-proofing discipline from bench.py):
+measured (hang-proofing discipline from bench.py), with per-section fault
+isolation so one tunnel blip cannot lose the remaining sections:
 
   1. tpu_single_preset — config 3's literal preset through the round-4
      device-resident multi-round searcher (VERDICT item 5: was 2.83 MH/s
@@ -33,21 +34,26 @@ def emit(section, payload):
           flush=True)
 
 
-def main():
-    import jax
-    emit("platform", jax.default_backend())
+def _section(name, fn):
+    try:
+        fn()
+    except Exception as e:
+        import traceback
+        emit(f"{name}_error", {"error": f"{type(e).__name__}: {e}",
+                               "traceback": traceback.format_exc()[-2000:]})
 
+
+def _tpu_single():
+    from mpi_blockchain_tpu.bench_lib import bench_tpu_single
+    emit("tpu_single_preset", bench_tpu_single())
+
+
+def _early_exit():
     from mpi_blockchain_tpu import core
     from mpi_blockchain_tpu.config import MinerConfig
     from mpi_blockchain_tpu.models.fused import FusedMiner
     from mpi_blockchain_tpu.ops import sha256_pallas as sp
-    from mpi_blockchain_tpu.parallel.mesh import make_miner_mesh
 
-    # ---- 1. config-3 literal preset through the multi-round searcher ----
-    from mpi_blockchain_tpu.bench_lib import bench_tpu_single
-    emit("tpu_single_preset", bench_tpu_single())
-
-    # ---- 2. while-impl early exit: correctness then chain bench ---------
     hdr = bytes(range(80))
     midstate, tail = core.header_midstate(hdr)
     results = {}
@@ -81,10 +87,18 @@ def main():
         "identical_tips": tips["grid"] == tips["while"],
         "while_minus_grid_s": round(bench["while"] - bench["grid"], 2),
         "while_faster": bench["while"] < bench["grid"]})
-    sp.EARLY_EXIT_IMPL = "grid"   # restore default for section 3
 
-    # ---- 3. sharded pallas on a 1-device ('miners',) mesh ---------------
+
+def _sharded_pallas():
+    from mpi_blockchain_tpu import core
     from mpi_blockchain_tpu.backend.tpu import make_multiround_search_fn
+    from mpi_blockchain_tpu.bench_lib import bench_sharded_pallas
+    from mpi_blockchain_tpu.ops import sha256_pallas as sp
+    from mpi_blockchain_tpu.parallel.mesh import make_miner_mesh
+
+    sp.EARLY_EXIT_IMPL = "grid"   # restore default if section 2 flipped it
+    hdr = bytes(range(80))
+    midstate, tail = core.header_midstate(hdr)
     mesh = make_miner_mesh(1)
     fn, eff = make_multiround_search_fn(1 << 20, 16, n_miners=1, mesh=mesh,
                                         kernel="pallas")
@@ -96,10 +110,17 @@ def main():
                            "min_nonce": mn, "cpu_oracle": cpu16,
                            "min_matches_cpu_oracle": sweep_ok})
 
-    from mpi_blockchain_tpu.bench_lib import bench_sharded_pallas
     payload = bench_sharded_pallas()
     payload["sweep_min_matches_cpu_oracle"] = sweep_ok
     emit("sharded_pallas", payload)
+
+
+def main():
+    import jax
+    emit("platform", jax.default_backend())
+    _section("tpu_single_preset", _tpu_single)
+    _section("early_exit", _early_exit)
+    _section("sharded_pallas", _sharded_pallas)
 
 
 if __name__ == "__main__":
