@@ -56,37 +56,41 @@ def _early_exit():
 
     hdr = bytes(range(80))
     midstate, tail = core.header_midstate(hdr)
-    results = {}
-    for impl in ("grid", "while"):
-        sp.EARLY_EXIT_IMPL = impl
-        fn = sp.make_pallas_sweep_fn(sp.TILE * 4, 8, early_exit=True)
-        c, m = fn(midstate, tail, np.uint32(0))
-        results[impl] = (int(c), int(m))
-    cpu_min, _ = core.cpu_search(hdr, 0, sp.TILE * 4, 8)
-    emit("early_exit_correctness", {
-        "grid": results["grid"], "while": results["while"],
-        "min_matches_oracle": results["grid"][1] == results["while"][1]
-        == cpu_min})
+    saved_impl = sp.EARLY_EXIT_IMPL
+    try:
+        results = {}
+        for impl in ("grid", "while"):
+            sp.EARLY_EXIT_IMPL = impl
+            fn = sp.make_pallas_sweep_fn(sp.TILE * 4, 8, early_exit=True)
+            c, m = fn(midstate, tail, np.uint32(0))
+            results[impl] = (int(c), int(m))
+        cpu_min, _ = core.cpu_search(hdr, 0, sp.TILE * 4, 8)
+        emit("early_exit_correctness", {
+            "grid": results["grid"], "while": results["while"],
+            "min_matches_oracle": results["grid"][1] == results["while"][1]
+            == cpu_min})
 
-    bench = {}
-    tips = {}
-    for impl in ("grid", "while"):
-        sp.EARLY_EXIT_IMPL = impl
-        fm = FusedMiner(MinerConfig(difficulty_bits=24, n_blocks=100,
-                                    batch_pow2=24, backend="tpu",
-                                    kernel="pallas"),
-                        blocks_per_call=25, log_fn=lambda d: None)
-        fm.warmup()
-        t0 = time.perf_counter()
-        fm.mine_chain()
-        bench[impl] = round(time.perf_counter() - t0, 2)
-        tips[impl] = fm.node.tip_hash.hex()
-        emit(f"early_exit_bench_{impl}", {
-            "wall_s_100_blocks_diff24": bench[impl], "tip": tips[impl]})
-    emit("early_exit_verdict", {
-        "identical_tips": tips["grid"] == tips["while"],
-        "while_minus_grid_s": round(bench["while"] - bench["grid"], 2),
-        "while_faster": bench["while"] < bench["grid"]})
+        bench = {}
+        tips = {}
+        for impl in ("grid", "while"):
+            sp.EARLY_EXIT_IMPL = impl
+            fm = FusedMiner(MinerConfig(difficulty_bits=24, n_blocks=100,
+                                        batch_pow2=24, backend="tpu",
+                                        kernel="pallas"),
+                            blocks_per_call=25, log_fn=lambda d: None)
+            fm.warmup()
+            t0 = time.perf_counter()
+            fm.mine_chain()
+            bench[impl] = round(time.perf_counter() - t0, 2)
+            tips[impl] = fm.node.tip_hash.hex()
+            emit(f"early_exit_bench_{impl}", {
+                "wall_s_100_blocks_diff24": bench[impl], "tip": tips[impl]})
+        emit("early_exit_verdict", {
+            "identical_tips": tips["grid"] == tips["while"],
+            "while_minus_grid_s": round(bench["while"] - bench["grid"], 2),
+            "while_faster": bench["while"] < bench["grid"]})
+    finally:
+        sp.EARLY_EXIT_IMPL = saved_impl
 
 
 def _sharded_pallas():
@@ -96,7 +100,6 @@ def _sharded_pallas():
     from mpi_blockchain_tpu.ops import sha256_pallas as sp
     from mpi_blockchain_tpu.parallel.mesh import make_miner_mesh
 
-    sp.EARLY_EXIT_IMPL = "grid"   # restore default if section 2 flipped it
     hdr = bytes(range(80))
     midstate, tail = core.header_midstate(hdr)
     mesh = make_miner_mesh(1)

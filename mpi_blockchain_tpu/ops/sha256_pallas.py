@@ -246,14 +246,25 @@ def pallas_sweep_core(midstate, tail_w, base_nonce, *, batch_size: int,
                          memory_space=pltpu.SMEM),
         ],
     )
+    ms = jnp.asarray(midstate, _U32)
+    tw = jnp.asarray(tail_w, _U32)
+    bn = jnp.asarray(base_nonce, _U32).reshape((1,))
+    # Under shard_map with check_vma=True (the JAX >= 0.9 default), pallas
+    # outputs must declare which mesh axes they vary over; they inherit the
+    # union of the inputs' axes (the per-device base_nonce carries the
+    # 'miners' axis). Outside shard_map every vma is empty — a no-op.
+    vma = frozenset().union(*(getattr(jax.typeof(x), "vma", frozenset())
+                              for x in (ms, tw, bn)))
+    # Only pass the kwarg when non-empty, so JAX versions without
+    # ShapeDtypeStruct(vma=...) keep working outside shard_map.
+    vma_kw = {"vma": vma} if vma else {}
     count, min_biased = pl.pallas_call(
         kernel,
-        out_shape=[jax.ShapeDtypeStruct((1, 1), jnp.int32),
-                   jax.ShapeDtypeStruct((1, 1), jnp.int32)],
+        out_shape=[jax.ShapeDtypeStruct((1, 1), jnp.int32, **vma_kw),
+                   jax.ShapeDtypeStruct((1, 1), jnp.int32, **vma_kw)],
         grid_spec=grid_spec,
         interpret=interpret,
-    )(jnp.asarray(midstate, _U32), jnp.asarray(tail_w, _U32),
-      jnp.asarray(base_nonce, _U32).reshape((1,)))
+    )(ms, tw, bn)
     min_nonce = jax.lax.bitcast_convert_type(
         min_biased[0, 0], _U32) ^ np.uint32(0x80000000)
     return count[0, 0], min_nonce

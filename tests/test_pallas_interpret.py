@@ -144,6 +144,48 @@ def test_while_kernel_not_found(monkeypatch):
     assert (count, mn) == (0, 0xFFFFFFFF)
 
 
+def test_sharded_pallas_under_shard_map(monkeypatch):
+    """Regression: pallas_call under shard_map. JAX >= 0.9's check_vma=True
+    rejects pallas out_shapes without a vma annotation — first hit on real
+    hardware in round 4 (the combination had never executed anywhere else,
+    CI always substituting kernel="jnp"). pallas_sweep_core now derives vma
+    from its inputs, which fixes the Mosaic (hardware) lowering; the
+    interpret-mode interpreter used here additionally mis-tracks vma inside
+    its own block dynamic_slices (JAX asks for check_vma=False as the
+    workaround), so this test disables the check on ITS shard_map only —
+    production mesh.py keeps check_vma=True, hardware-proven by the
+    sharded_pallas bench section. What this covers in CI: the pallas
+    program executing per-device under shard_map and reducing through the
+    production sharded_local_base + winner_select on a 4-device mesh."""
+    import functools
+
+    from jax.sharding import PartitionSpec as P
+
+    from mpi_blockchain_tpu.parallel.mesh import (make_miner_mesh,
+                                                  sharded_local_base,
+                                                  winner_select)
+
+    monkeypatch.setattr(sp, "EARLY_EXIT_IMPL", "grid")
+    monkeypatch.setattr(sp, "_tile_result", _mock_tile)
+    n_miners, n_tiles, q = 4, 2, 3 * sp.TILE   # qualifiers on most devices
+    batch = n_tiles * sp.TILE
+    sweep = functools.partial(sp.pallas_sweep_core, batch_size=batch,
+                              difficulty_bits=8, interpret=True)
+
+    def per_device(midstate, tail_w, base):
+        c, m = sweep(midstate, tail_w, sharded_local_base(base, batch))
+        return winner_select(c, m)
+
+    fn = jax.jit(jax.shard_map(per_device, mesh=make_miner_mesh(n_miners),
+                               in_specs=(P(), P(), P()),
+                               out_specs=(P(), P()), check_vma=False))
+    tail = np.zeros(16, np.uint32)
+    tail[0] = q
+    count, mn = fn(np.zeros(8, np.uint32), tail, np.uint32(1))
+    exp_c, exp_m = _expected(1, n_miners * batch, q)
+    assert (int(count), int(mn)) == (exp_c, exp_m)
+
+
 def test_batch_validation_offline():
     with pytest.raises(ValueError):
         sp.pallas_sweep_core(np.zeros(8, np.uint32), np.zeros(16, np.uint32),
