@@ -27,7 +27,6 @@ see bench.py — or stay device-resident (models/fused.py).
 from __future__ import annotations
 
 import functools
-import os
 
 import jax
 import jax.numpy as jnp
@@ -41,9 +40,6 @@ _U32 = jnp.uint32
 _LANES = 128
 _ROWS = 64                      # 64*128 = 8192 nonces per grid program
 TILE = _ROWS * _LANES
-
-# Early-exit kernel implementation: "grid" or "while" (see pallas_sweep_core).
-EARLY_EXIT_IMPL = os.environ.get("MBT_EARLY_EXIT_IMPL", "grid")
 
 
 def _rotr(x, n: int):
@@ -177,33 +173,22 @@ def _sweep_kernel(midstate_ref, tail_ref, base_ref, count_ref, min_ref, *,
         tile()
 
 
-def _mine_kernel(midstate_ref, tail_ref, base_ref, count_ref, min_ref, *,
-                 difficulty_bits: int, n_tiles: int):
-    """Early-exit sweep as ONE program: a while_loop over ascending tiles
-    that stops at the first tile containing a qualifier.
+def _out_vma(*xs) -> frozenset:
+    """Union of the inputs' varying-manual-axes (vma) sets.
 
-    Versus the sequential-grid variant with per-program skip predicates,
-    the not-taken tiles cost nothing at all (the loop just exits) — at
-    mining batch sizes that is ~1 ms/block of skipped-tile overhead gone.
-    min_nonce is exact (ascending order); count is exact through the first
-    qualifying tile, i.e. a found-flag — the mine-loop contract.
-    """
-    def cond(s):
-        t, c, _ = s
-        return (c == 0) & (t < n_tiles)
-
-    def body(s):
-        t, _, _ = s
-        base = base_ref[0] + t.astype(_U32) * np.uint32(TILE)
-        c, m = _tile_result(midstate_ref, tail_ref, base,
-                            difficulty_bits=difficulty_bits)
-        return t + np.int32(1), c, m
-
-    _, c, m = jax.lax.while_loop(
-        cond, body,
-        (jnp.int32(0), jnp.int32(0), jnp.int32(0x7FFFFFFF)))
-    count_ref[0, 0] = c
-    min_ref[0, 0] = m
+    Under shard_map with check_vma=True (the JAX >= 0.9 default), pallas
+    outputs must declare which mesh axes they vary over; they inherit the
+    union of the inputs' axes (the per-device base_nonce carries the
+    'miners' axis). Outside shard_map — or on a JAX predating the vma
+    machinery, where jax.typeof does not exist — every set is empty.
+    Unit-tested under a real check_vma=True trace in
+    tests/test_pallas_interpret.py (the interpret-mode pallas execution
+    path cannot carry vma itself; see that module's docstring)."""
+    typeof = getattr(jax, "typeof", None)
+    if typeof is None:
+        return frozenset()
+    return frozenset().union(*(getattr(typeof(x), "vma", frozenset())
+                               for x in xs))
 
 
 def pallas_sweep_core(midstate, tail_w, base_nonce, *, batch_size: int,
@@ -221,20 +206,16 @@ def pallas_sweep_core(midstate, tail_w, base_nonce, *, batch_size: int,
         raise ValueError(f"batch_size {batch_size} not a multiple of {TILE}")
     n_tiles = batch_size // TILE
 
-    # Early-exit implementations: "grid" (per-program skip predicate,
-    # hardware-validated) vs "while" (single program, lax.while_loop over
-    # tiles — skipped tiles cost nothing, ~1 ms/block less overhead, but
-    # NOT yet validated on hardware: flip the default once it is).
-    if early_exit and EARLY_EXIT_IMPL == "while":
-        kernel = functools.partial(_mine_kernel,
-                                   difficulty_bits=difficulty_bits,
-                                   n_tiles=n_tiles)
-        grid = (1,)    # ONE program; the tile loop lives inside the kernel
-    else:
-        kernel = functools.partial(_sweep_kernel,
-                                   difficulty_bits=difficulty_bits,
-                                   early_exit=early_exit)
-        grid = (n_tiles,)
+    # A single-program lax.while_loop-over-tiles variant of the early-exit
+    # kernel was hardware-benchmarked in round 4 (experiments/hw_round4.py)
+    # against this grid + skip-predicate form: identical tips, timing a tie
+    # within tunnel noise over 4 rep pairs (grid 1.85-2.55 s, while
+    # 1.84-2.16 s per 100 diff-24 blocks), so the extra implementation was
+    # deleted rather than kept as an env-selected alternate.
+    kernel = functools.partial(_sweep_kernel,
+                               difficulty_bits=difficulty_bits,
+                               early_exit=early_exit)
+    grid = (n_tiles,)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,      # midstate, tail, base — all SMEM scalars
         grid=grid,
@@ -249,14 +230,9 @@ def pallas_sweep_core(midstate, tail_w, base_nonce, *, batch_size: int,
     ms = jnp.asarray(midstate, _U32)
     tw = jnp.asarray(tail_w, _U32)
     bn = jnp.asarray(base_nonce, _U32).reshape((1,))
-    # Under shard_map with check_vma=True (the JAX >= 0.9 default), pallas
-    # outputs must declare which mesh axes they vary over; they inherit the
-    # union of the inputs' axes (the per-device base_nonce carries the
-    # 'miners' axis). Outside shard_map every vma is empty — a no-op.
-    vma = frozenset().union(*(getattr(jax.typeof(x), "vma", frozenset())
-                              for x in (ms, tw, bn)))
     # Only pass the kwarg when non-empty, so JAX versions without
     # ShapeDtypeStruct(vma=...) keep working outside shard_map.
+    vma = _out_vma(ms, tw, bn)
     vma_kw = {"vma": vma} if vma else {}
     count, min_biased = pl.pallas_call(
         kernel,

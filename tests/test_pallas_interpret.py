@@ -11,13 +11,13 @@ So coverage is split along the kernel's own seam:
   optimized round algebra, qualify check, bias trick) runs EAGERLY
   (``jax.disable_jit``: op-by-op, no whole-graph compile) against the C++
   oracle — bit-exactness of the hash;
-* the kernel programs (``_sweep_kernel`` grid accumulation + early-exit
-  skip predicate, ``_mine_kernel`` while-loop) run in ``interpret=True``
-  mode through the real ``pallas_sweep_core`` wiring (scalar prefetch,
-  SMEM outputs, bias decode) with ``_tile_result`` monkeypatched to a
-  cheap mock of identical contract — the program logic, in milliseconds.
-  Both kernels look the mock up as a module global at trace time, so no
-  production test seam is needed.
+* the kernel program (``_sweep_kernel`` grid accumulation + early-exit
+  skip predicate) runs in ``interpret=True`` mode through the real
+  ``pallas_sweep_core`` wiring (scalar prefetch, SMEM outputs, bias
+  decode) with ``_tile_result`` monkeypatched to a cheap mock of
+  identical contract — the program logic, in milliseconds. The kernel
+  looks the mock up as a module global at trace time, so no production
+  test seam is needed.
 
 Hardware integration of the two halves stays covered by
 tests/test_pallas.py + bench.py on the real chip.
@@ -82,11 +82,8 @@ def _mock_tile(midstate_ref, tail_ref, base, *, difficulty_bits):
 
 
 def _mock_sweep(monkeypatch, base: int, n_tiles: int, q: int,
-                early_exit: bool, impl: str = "grid"):
-    # Pin BOTH seams: the env-derived impl choice (so an ambient
-    # MBT_EARLY_EXIT_IMPL can't silently retarget a grid test to the while
-    # kernel) and the tile function the kernels resolve as module global.
-    monkeypatch.setattr(sp, "EARLY_EXIT_IMPL", impl)
+                early_exit: bool):
+    # The kernel resolves _tile_result as a module global at trace time.
     monkeypatch.setattr(sp, "_tile_result", _mock_tile)
     tail = np.zeros(16, np.uint32)
     tail[0] = q
@@ -126,22 +123,36 @@ def test_grid_kernel_early_exit_skips_after_first_qualifier(monkeypatch):
     assert count < full_c   # proves post-winner tiles were skipped
 
 
-def test_while_kernel_matches_grid_contract(monkeypatch):
-    q = 3 * sp.TILE // 2
-    base, n_tiles = 1, 4
-    g = _mock_sweep(monkeypatch, base, n_tiles, q, early_exit=True,
-                    impl="grid")
-    w = _mock_sweep(monkeypatch, base, n_tiles, q, early_exit=True,
-                    impl="while")
-    # Same min (the determinism contract); count exact through the first
-    # qualifying tile for both implementations.
-    assert w == g
-
-
-def test_while_kernel_not_found(monkeypatch):
+def test_early_exit_not_found(monkeypatch):
     count, mn = _mock_sweep(monkeypatch, 1, 2, 10 * sp.TILE,
-                            early_exit=True, impl="while")
+                            early_exit=True)
     assert (count, mn) == (0, 0xFFFFFFFF)
+
+
+def test_out_vma_derivation_under_check_vma_trace():
+    """The vma-derivation fix itself, under a REAL check_vma=True shard_map
+    trace (no pallas execution — the interpret path cannot carry vma, so
+    the execution test below runs with check_vma=False and this test pins
+    the derivation): a replicated input contributes nothing; an input
+    offset by axis_index carries the 'miners' axis into the union."""
+    from jax.sharding import PartitionSpec as P
+
+    from mpi_blockchain_tpu.parallel.mesh import (make_miner_mesh,
+                                                  sharded_local_base)
+
+    captured = {}
+
+    def f(base):
+        varying = sharded_local_base(base, 8)
+        captured["replicated"] = sp._out_vma(base)
+        captured["union"] = sp._out_vma(base, varying)
+        return jax.lax.pmax(varying, "miners")
+
+    fn = jax.shard_map(f, mesh=make_miner_mesh(4), in_specs=(P(),),
+                       out_specs=P())
+    jax.eval_shape(fn, jax.ShapeDtypeStruct((), jnp.uint32))
+    assert captured["replicated"] == frozenset()
+    assert captured["union"] == frozenset({"miners"})
 
 
 def test_sharded_pallas_under_shard_map(monkeypatch):
@@ -165,7 +176,6 @@ def test_sharded_pallas_under_shard_map(monkeypatch):
                                                   sharded_local_base,
                                                   winner_select)
 
-    monkeypatch.setattr(sp, "EARLY_EXIT_IMPL", "grid")
     monkeypatch.setattr(sp, "_tile_result", _mock_tile)
     n_miners, n_tiles, q = 4, 2, 3 * sp.TILE   # qualifiers on most devices
     batch = n_tiles * sp.TILE
