@@ -56,9 +56,13 @@ emit("sweep", bench_tpu(seconds=8.0, batch_pow2=28, n_miners=1,
                         kernel="auto"))
 # Second half of the metric: wall-clock to mine 1000 blocks at difficulty
 # 24 (real accelerator only -- the host-CPU fallback would take hours).
+# blocks_per_call=500 from the round-4 hardware sweep: 18.6-18.7 s vs
+# 19.3-19.5 s at 100/250 (fewer host syncs); 1000 was no faster and a
+# single dispatch gives the watchdog no mid-run evidence.
 if jax.default_backend() != "cpu":
     try:
-        emit("chain", bench_chain(n_blocks=1000, difficulty_bits=24))
+        emit("chain", bench_chain(n_blocks=1000, difficulty_bits=24,
+                                  blocks_per_call=500))
     except Exception as e:
         emit("chain_error", f"{type(e).__name__}: {e}")
     # Config 4's exact production combination on hardware: shard_map +
