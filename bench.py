@@ -90,6 +90,24 @@ print("BENCH_JSON:" + json.dumps({"section": "platform",
       flush=True)
 """
 
+# Utilization at the measured rate (experiments/roofline.py: traced op
+# census x rate / VPU peak). Pure CPU-side jaxpr tracing, so it runs in its
+# own child — NOT in the device child, whose chip/watchdog budget it would
+# burn and whose global jax config roofline.py's import-time
+# jax_platforms=cpu would mutate.
+_ROOFLINE_CODE = """
+# MBT_BENCH_SECTION roofline child
+import importlib.util, json, os
+spec = importlib.util.spec_from_file_location("roofline",
+                                              "experiments/roofline.py")
+rl = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(rl)
+payload = rl.roofline(float(os.environ["MBT_ROOFLINE_MHS"]))
+print("BENCH_JSON:" + json.dumps({"section": "utilization",
+                                  "payload": payload}), flush=True)
+"""
+
+
 # Config 4's determinism as a per-round record: the fused sharded miner on a
 # virtual 8-device CPU mesh must produce byte-identical blocks to the C++
 # scalar oracle (lowest-qualifying-nonce winner rule makes this exact).
@@ -292,6 +310,12 @@ def _run_sharded_section() -> tuple[dict, str | None]:
                          env=force_cpu_mesh_env(os.environ, 8))
 
 
+def _run_roofline_section(measured_mhs: float) -> tuple[dict, str | None]:
+    return _stream_child(_ROOFLINE_CODE, timeout_s=300,
+                         env={**os.environ,
+                              "MBT_ROOFLINE_MHS": str(measured_mhs)})
+
+
 # ---- assembly ---------------------------------------------------------------
 
 def main() -> int:
@@ -334,6 +358,20 @@ def main() -> int:
             cached_val = _cached(section)
             if cached_val:
                 detail[section] = cached_val
+
+    # Roofline at whatever sweep rate is being reported (fresh or cached).
+    if sweep is not None and "hashes_per_sec_per_chip" in sweep:
+        util, util_err = _run_roofline_section(
+            sweep["hashes_per_sec_per_chip"] / 1e6)
+        if "utilization" in util:
+            detail["utilization"] = util["utilization"]
+            _cache_store("utilization", util["utilization"])
+        else:
+            cached_util = _cached("utilization")
+            if cached_util:
+                detail["utilization"] = cached_util
+            elif util_err:
+                detail["utilization"] = {"error": util_err}
 
     chain = dev.get("chain")
     if chain is not None:

@@ -1,0 +1,176 @@
+"""The bench.py evidence pipeline, off-hardware.
+
+bench.py is the official per-round record: a latent bug in its streaming /
+cache / assembly logic can zero out a round's numbers even when the chip
+performed (round 1 lost a measured 971.8 MH/s exactly that way). These
+tests cover the pipeline with no device at all: section streaming survives
+child death and timeouts, the cache round-trips, and main() assembles
+fresh vs cached vs fallback records honestly.
+"""
+import json
+
+import pytest
+
+import bench
+
+
+@pytest.fixture
+def tmp_cache(tmp_path, monkeypatch):
+    monkeypatch.setattr(bench, "CACHE_PATH", tmp_path / "CACHE.json")
+
+
+# ---- cache ------------------------------------------------------------------
+
+def test_cache_roundtrip(tmp_cache):
+    bench._cache_store("sweep", {"hashes_per_sec_per_chip": 1.0})
+    got = bench._cached("sweep")
+    assert got["hashes_per_sec_per_chip"] == 1.0
+    assert got["cached"] is True
+    assert "measured_at" in got
+
+
+def test_cache_missing_section(tmp_cache):
+    assert bench._cached("nope") is None
+
+
+def test_cache_survives_corrupt_file(tmp_cache, tmp_path):
+    (tmp_path / "CACHE.json").write_text("{not json")
+    assert bench._cached("sweep") is None
+    bench._cache_store("sweep", {"v": 2})        # overwrites, no raise
+    assert bench._cached("sweep")["v"] == 2
+
+
+# ---- streaming child runner -------------------------------------------------
+
+def test_stream_child_preserves_sections_on_child_death():
+    code = """
+import json, sys
+print("BENCH_JSON:" + json.dumps({"section": "a", "payload": 1}), flush=True)
+print("BENCH_JSON:" + json.dumps({"section": "b", "payload": 2}), flush=True)
+sys.stderr.write("boom\\n")
+sys.exit(3)
+"""
+    sections, err = bench._stream_child(code, timeout_s=60)
+    assert sections == {"a": 1, "b": 2}
+    assert "rc=3" in err and "boom" in err
+
+
+def test_stream_child_preserves_sections_on_timeout():
+    code = """
+import json, time
+print("BENCH_JSON:" + json.dumps({"section": "a", "payload": 1}), flush=True)
+time.sleep(600)
+"""
+    sections, err = bench._stream_child(code, timeout_s=3)
+    assert sections == {"a": 1}
+    assert "timed out" in err
+
+
+def test_stream_child_ignores_malformed_lines():
+    code = """
+import json
+print("BENCH_JSON:{not json", flush=True)
+print("unrelated stdout", flush=True)
+print("BENCH_JSON:" + json.dumps({"section": "ok", "payload": 5}), flush=True)
+"""
+    sections, err = bench._stream_child(code, timeout_s=60)
+    assert sections == {"ok": 5}
+    assert err is None
+
+
+# ---- main() assembly --------------------------------------------------------
+
+_CPU = {"backend": "cpu", "n_miners": 8, "hashes": 100, "wall_s": 1.0,
+        "hashes_per_sec": 1.6e6, "hashes_per_sec_per_rank": 2e5}
+_SWEEP = {"backend": "tpu", "n_miners": 1, "kernel": "pallas",
+          "batch_pow2": 28, "platform": "tpu", "hashes": 10, "wall_s": 1.0,
+          "hashes_per_sec": 9.6e8, "hashes_per_sec_per_chip": 9.6e8}
+_SHARDED = {"sharded_chain": {"tip_matches_cpu_oracle": True}}
+
+
+def _run_main(monkeypatch, capsys, dev_sections, dev_err=None,
+              sharded=_SHARDED, sharded_err=None,
+              roofline=({"utilization": {"vpu_utilization_pct": 95.0}},
+                        None)):
+    from mpi_blockchain_tpu import bench_lib
+    monkeypatch.setattr(bench_lib, "bench_cpu",
+                        lambda seconds, n_miners: dict(_CPU))
+    monkeypatch.setattr(bench, "_run_device_section",
+                        lambda: (dev_sections, dev_err))
+    monkeypatch.setattr(bench, "_run_sharded_section",
+                        lambda: (sharded, sharded_err))
+    roofline_calls = []
+    monkeypatch.setattr(bench, "_run_roofline_section",
+                        lambda mhs: (roofline_calls.append(mhs),
+                                     roofline)[1])
+    assert bench.main() == 0
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    return json.loads(out), roofline_calls
+
+def test_main_fresh_device_record(tmp_cache, monkeypatch, capsys):
+    dev = {"platform": "tpu", "sweep": dict(_SWEEP),
+           "chain": {"wall_s": 20.0, "tip_hash": "ab"},
+           "tpu_single": {"mhs": 30.0},
+           "sharded_pallas": {"tip_matches_cpu_oracle": True}}
+    rec, roofline_calls = _run_main(monkeypatch, capsys, dev)
+    assert rec["source"] == "fresh"
+    assert rec["value"] == 9.6e8
+    assert rec["detail"]["utilization"]["vpu_utilization_pct"] == 95.0
+    assert roofline_calls == [960.0]     # driven by the measured sweep rate
+    assert rec["detail"]["chain_1000_diff24"]["wall_s"] == 20.0
+    assert rec["detail"]["sharded_chain"]["tip_matches_cpu_oracle"]
+    # every measured section was persisted for the next outage
+    for section in ("sweep", "chain", "tpu_single", "sharded_pallas",
+                    "utilization"):
+        assert bench._cached(section) is not None
+
+
+def test_main_falls_back_to_cache_on_device_outage(tmp_cache, monkeypatch,
+                                                   capsys):
+    for section, payload in (("sweep", dict(_SWEEP)),
+                             ("chain", {"wall_s": 21.0, "tip_hash": "cd"}),
+                             ("tpu_single", {"mhs": 29.0}),
+                             ("utilization", {"vpu_utilization_pct": 94.0})):
+        bench._cache_store(section, payload)
+    # roofline child also failing must fall back to the cached utilization
+    rec, roofline_calls = _run_main(monkeypatch, capsys, {},
+                                    dev_err="tunnel wedged",
+                                    roofline=({}, "no jax"))
+    assert rec["source"] == "cache"
+    assert rec["value"] == 9.6e8                  # last-good, not zeroed
+    assert roofline_calls == [960.0]   # still recomputed from cached sweep
+    assert rec["detail"]["device_error"] == "tunnel wedged"
+    assert rec["detail"]["tpu"]["cached"] is True
+    assert rec["detail"]["chain_1000_diff24"]["cached"] is True
+    assert rec["detail"]["tpu_single"]["cached"] is True
+    assert rec["detail"]["utilization"]["cached"] is True
+
+
+def test_main_cpu_fallback_when_no_cache(tmp_cache, monkeypatch, capsys):
+    rec, roofline_calls = _run_main(monkeypatch, capsys, {},
+                                    dev_err="tunnel wedged")
+    assert rec["source"] == "cpu-fallback"
+    assert rec["value"] == 2e5                    # per-rank CPU rate
+    assert rec["vs_baseline"] == 0.125
+    assert roofline_calls == []        # no chip rate -> no roofline claim
+
+
+def test_main_rejects_cpu_platform_sweep_as_fresh(tmp_cache, monkeypatch,
+                                                  capsys):
+    # The device child silently falling back to the host CPU platform must
+    # not be recorded as a fresh chip measurement.
+    dev = {"platform": "cpu", "sweep": dict(_SWEEP)}
+    rec, _ = _run_main(monkeypatch, capsys, dev)
+    assert rec["source"] == "cpu-fallback"
+    assert "cpu platform" in rec["detail"]["device_error"]
+
+
+def test_roofline_child_end_to_end(tmp_cache):
+    # The real child subprocess: loads experiments/roofline.py, traces the
+    # production tile, reports utilization at the requested rate.
+    sections, err = bench._run_roofline_section(971.8)
+    assert err is None
+    util = sections["utilization"]
+    assert util["measured_mhs"] == 971.8
+    assert 50 < util["vpu_utilization_pct"] <= 100
+    assert util["alu_ops_per_nonce"] > 4000   # ~2 compressions of u32 work
