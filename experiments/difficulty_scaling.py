@@ -1,0 +1,44 @@
+"""Difficulty-scaling curve for the fused miner (BASELINE.md table).
+
+Mines a chain segment at each difficulty in one dispatch (batch 2^24),
+min-of-3 reps per point — the axon tunnel occasionally inflates a single
+run >10x, so the min is the honest kernel-side number — and checks tip
+determinism across reps. Reproduces the "Difficulty-scaling curve" table:
+
+Usage: python experiments/difficulty_scaling.py
+"""
+from __future__ import annotations
+
+import json
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+POINTS = ((16, 200), (20, 200), (24, 100), (26, 50))
+REPS = 3
+
+
+def main() -> None:
+    from mpi_blockchain_tpu.bench_lib import bench_chain
+
+    for difficulty, n_blocks in POINTS:
+        walls, tips = [], set()
+        for _ in range(REPS):
+            r = bench_chain(n_blocks=n_blocks, difficulty_bits=difficulty,
+                            batch_pow2=24, blocks_per_call=n_blocks)
+            walls.append(r["wall_s"])
+            tips.add(r["tip_hash"])
+        wall = min(walls)
+        print(json.dumps({
+            "difficulty": difficulty, "blocks": n_blocks,
+            "min_wall_s": wall, "all_wall_s": walls,
+            "blocks_per_sec": round(n_blocks / wall, 1),
+            "effective_mhs": round(n_blocks * (1 << difficulty)
+                                   / wall / 1e6, 1),
+            "deterministic_tips": len(tips) == 1,
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()
