@@ -44,3 +44,17 @@ def test_world_info_single_process():
     info = world_info()
     assert info["process_count"] == 1
     assert info["global_devices"] == 8  # virtual CPU mesh from conftest
+
+
+def test_experiment_scripts_parse():
+    """experiments/ scripts are run standalone on hardware, outside the CI
+    import graph — a stale rename (e.g. a deleted kernel knob) would
+    otherwise only surface mid-measurement on the chip."""
+    import ast
+    import pathlib
+
+    scripts = sorted((pathlib.Path(__file__).parent.parent
+                      / "experiments").glob("*.py"))
+    assert scripts
+    for f in scripts:
+        ast.parse(f.read_text(), filename=str(f))
