@@ -53,3 +53,13 @@ def test_miner_metrics():
     assert miner.hashes_per_sec() > 0
     assert len(miner.records) == 3
     assert [r.height for r in miner.records] == [1, 2, 3]
+
+
+def test_difficulty_zero_identical_chains():
+    """Difficulty 0: every hash qualifies, so the deterministic winner is
+    nonce 0 on every block, on every backend."""
+    cpu = mine(MinerConfig(difficulty_bits=0, n_blocks=3, backend="cpu"))
+    tpu = mine(MinerConfig(difficulty_bits=0, n_blocks=3, backend="tpu",
+                           kernel="jnp", batch_pow2=10))
+    assert cpu.chain_hashes() == tpu.chain_hashes()
+    assert all(rec.nonce == 0 for rec in cpu.records)
