@@ -25,3 +25,14 @@ import jax  # noqa: E402
 if _PLATFORM == "cpu":
     jax.config.update("jax_platforms", "cpu")
     assert jax.devices()[0].platform == "cpu", jax.devices()
+
+
+def needs_devices(n: int):
+    """Skip marker for tests that build an n-device mesh. The CPU suite
+    always has 8 virtual devices (above); under MBT_TEST_PLATFORM=tpu the
+    suite runs against real hardware, where a single chip should skip the
+    multi-device mesh tests rather than fail them."""
+    import pytest
+    have = len(jax.devices())
+    return pytest.mark.skipif(
+        have < n, reason=f"needs {n} devices, platform has {have}")

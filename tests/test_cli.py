@@ -12,6 +12,8 @@ import json
 
 import pytest
 
+from conftest import needs_devices
+
 from mpi_blockchain_tpu.cli import main
 from mpi_blockchain_tpu.config import PRESETS, MinerConfig
 from mpi_blockchain_tpu.models.miner import Miner
@@ -36,7 +38,8 @@ def _oracle_hashes() -> tuple[str, ...]:
 
 
 @pytest.mark.parametrize("preset", ["cpu-single", "cpu-np4", "tpu-single",
-                                    "tpu-mesh8"])
+                                    pytest.param("tpu-mesh8",
+                                                 marks=needs_devices(8))])
 def test_preset_scenarios_identical_chain(preset):
     miner = Miner(_scaled(preset))
     miner.mine_chain()
@@ -178,6 +181,7 @@ def test_cli_oversubscribed_mesh_clean_error(capsys):
     assert rc == 2 and "9 devices" in out["error"]
 
 
+@needs_devices(8)
 def test_cli_bench_chain_sharded(capsys):
     rc = main(["bench", "--mode", "chain", "--blocks", "2", "--difficulty",
                "6", "--batch-pow2", "11", "--blocks-per-call", "2",

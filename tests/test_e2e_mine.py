@@ -6,6 +6,8 @@ config 1 merged with config 3 at reduced difficulty, plus the mesh variant
 of config 4.
 """
 
+from conftest import needs_devices
+
 from mpi_blockchain_tpu.config import MinerConfig, PRESETS
 from mpi_blockchain_tpu.models.miner import Miner
 
@@ -31,6 +33,7 @@ def test_cpu_vs_tpu_identical_chain():
         assert bytes.fromhex(rec.hash)[0] == 0 or DIFF < 8
 
 
+@needs_devices(8)
 def test_mesh_mine_identical_chain():
     cfg = MinerConfig(difficulty_bits=DIFF, n_blocks=5, batch_pow2=11,
                       n_miners=8, backend="tpu", kernel="jnp")

@@ -2,6 +2,8 @@
 import numpy as np
 import pytest
 
+from conftest import needs_devices
+
 from mpi_blockchain_tpu.config import MinerConfig
 from mpi_blockchain_tpu.models.fused import FusedMiner, make_fused_miner, \
     _words_be
@@ -18,7 +20,9 @@ def oracle_chain():
     return m
 
 
-@pytest.mark.parametrize("n_miners,batch_pow2", [(1, 12), (8, 9)])
+@pytest.mark.parametrize("n_miners,batch_pow2",
+                         [(1, 12),
+                          pytest.param(8, 9, marks=needs_devices(8))])
 def test_fused_identical_chain(oracle_chain, n_miners, batch_pow2):
     cfg = MinerConfig(difficulty_bits=DIFF, n_blocks=6,
                       batch_pow2=batch_pow2, n_miners=n_miners,

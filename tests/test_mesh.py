@@ -13,7 +13,12 @@ from mpi_blockchain_tpu.ops.sha256_jnp import make_sweep_fn
 from mpi_blockchain_tpu.parallel.mesh import (make_mesh_sweep_fn,
                                               make_miner_mesh)
 
+from conftest import needs_devices
+
 HDR = bytes(range(80))
+
+# Every test here builds a multi-device ('miners',) mesh.
+pytestmark = needs_devices(8)
 
 
 def _mesh_sweep(n_miners: int, batch: int, kernel="jnp"):

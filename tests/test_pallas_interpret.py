@@ -27,6 +27,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from conftest import needs_devices
+
 from mpi_blockchain_tpu import core
 from mpi_blockchain_tpu.ops import sha256_pallas as sp
 
@@ -129,6 +131,7 @@ def test_early_exit_not_found(monkeypatch):
     assert (count, mn) == (0, 0xFFFFFFFF)
 
 
+@needs_devices(4)
 def test_out_vma_derivation_under_check_vma_trace():
     """The vma-derivation fix itself, under a REAL check_vma=True shard_map
     trace (no pallas execution — the interpret path cannot carry vma, so
@@ -155,6 +158,7 @@ def test_out_vma_derivation_under_check_vma_trace():
     assert captured["union"] == frozenset({"miners"})
 
 
+@needs_devices(4)
 def test_sharded_pallas_under_shard_map(monkeypatch):
     """Regression: pallas_call under shard_map. JAX >= 0.9's check_vma=True
     rejects pallas out_shapes without a vma annotation — first hit on real

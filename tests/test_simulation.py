@@ -1,6 +1,8 @@
 """Multi-node simulation: propagation, delay, partition + reorg (config 5)."""
 import pytest
 
+from conftest import needs_devices
+
 from mpi_blockchain_tpu.config import MinerConfig
 from mpi_blockchain_tpu.simulation import Network, SimNode, run_adversarial
 
@@ -138,6 +140,7 @@ def test_nonce_exhaustion_opens_fresh_search_space():
         net.run(target_height=1, max_steps=5, nonce_budget=1 << 8)
 
 
+@needs_devices(2)
 def test_adversarial_with_tpu_backend_converges_and_matches_cpu():
     """SimNodes running the device sweep behind the plugin boundary
     (simulation.py backend dispatch): sim --backend tpu must converge AND
