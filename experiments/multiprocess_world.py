@@ -1,0 +1,82 @@
+"""The literal `mpirun -np 8` launch shape, run for real (BASELINE.md
+Hardware validations item 5).
+
+Spawns N OS processes, each with ONE local CPU device, that join a single
+jax.distributed world over a TCP coordinator and cooperatively mine one
+chain over the global ('miners',) mesh. Process 0 writes the chain; the
+result is compared byte-for-byte against the single-rank CPU oracle —
+the determinism contract across real process boundaries at the reference
+baseline's full rank count.
+
+Usage: python experiments/multiprocess_world.py [n_processes=8]
+"""
+from __future__ import annotations
+
+import json
+import pathlib
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+DIFF, BLOCKS = 12, 30
+
+_WRAPPER = """
+import jax
+jax.config.update("jax_platforms", "cpu")
+from mpi_blockchain_tpu.cli import main
+import sys
+sys.exit(main({argv!r}))
+"""
+
+
+def main(n_processes: int = 8) -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    tmp = tempfile.mkdtemp()
+    out_file = tmp + "/chain.bin"
+    base = ["mine", "--difficulty", str(DIFF), "--blocks", str(BLOCKS),
+            "--backend", "tpu", "--kernel", "jnp", "--batch-pow2", "10",
+            "--coordinator", f"127.0.0.1:{port}",
+            "--num-processes", str(n_processes)]
+    env = {"PATH": "/usr/bin:/bin", "PYTHONPATH": str(REPO),
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+           "HOME": tmp}
+    t0 = time.time()
+    procs = []
+    for i in range(n_processes):
+        argv = base + ["--process-id", str(i)]
+        if i == 0:
+            argv += ["--out", out_file]
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", _WRAPPER.format(argv=argv)],
+            env=env, cwd=str(REPO), stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True))
+    for p in procs:
+        _, err = p.communicate(timeout=350)
+        if p.returncode != 0:
+            print(json.dumps({"error": err[-1500:]}))
+            return 1
+    wall = round(time.time() - t0, 1)
+
+    from mpi_blockchain_tpu.config import MinerConfig
+    from mpi_blockchain_tpu.models.miner import Miner
+    oracle = Miner(MinerConfig(difficulty_bits=DIFF, n_blocks=BLOCKS,
+                               backend="cpu"), log_fn=lambda d: None)
+    oracle.mine_chain()
+    chain = pathlib.Path(out_file).read_bytes()
+    print(json.dumps({
+        "n_processes": n_processes, "difficulty": DIFF, "blocks": BLOCKS,
+        "wall_s": wall, "tip": oracle.node.tip_hash.hex(),
+        "identical_to_single_rank_oracle": chain == oracle.node.save(),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(int(sys.argv[1]) if len(sys.argv) > 1 else 8))
