@@ -394,6 +394,11 @@ def main() -> int:
         value = sweep["hashes_per_sec_per_chip"]
         vs = sweep["hashes_per_sec"] / cpu["hashes_per_sec"]
         detail["tpu"] = _round_floats(sweep)
+        # vs_baseline divides by the SAME-RUN CPU sample (honest, but the
+        # denominator load-varies 0.8-1.8 MH/s across rounds); this pins
+        # the canonical round-1 8-rank rate for cross-round comparison.
+        detail["vs_cpu_canonical_1p78_mhs"] = round(
+            sweep["hashes_per_sec"] / 1.78e6, 1)
     else:
         value = cpu["hashes_per_sec_per_rank"]
         vs = 1.0 / 8.0

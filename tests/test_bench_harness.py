@@ -116,6 +116,8 @@ def test_main_fresh_device_record(tmp_cache, monkeypatch, capsys):
     assert rec["source"] == "fresh"
     assert rec["value"] == 9.6e8
     assert rec["detail"]["utilization"]["vpu_utilization_pct"] == 95.0
+    assert rec["detail"]["vs_cpu_canonical_1p78_mhs"] == round(
+        9.6e8 / 1.78e6, 1)
     assert roofline_calls == [960.0]     # driven by the measured sweep rate
     assert rec["detail"]["chain_1000_diff24"]["wall_s"] == 20.0
     assert rec["detail"]["sharded_chain"]["tip_matches_cpu_oracle"]
