@@ -59,6 +59,22 @@ def get_backend(name: str, **kwargs) -> MinerBackend:
                          f"known: {sorted(_REGISTRY)}") from None
 
 
+def backend_from_config(config, cpu_ranks: int | None = None,
+                        mesh=None) -> MinerBackend:
+    """The one place a MinerConfig becomes a backend instance (shared by
+    Miner, FusedMiner's rollover path, and SimNode). cpu_ranks overrides
+    the CPU thread-rank count (SimNode runs each group as one rank);
+    mesh passes an explicit device mesh through to the TPU backend."""
+    if config.backend == "cpu":
+        return get_backend("cpu",
+                           n_ranks=(config.n_miners if cpu_ranks is None
+                                    else cpu_ranks),
+                           batch_size=config.batch_size)
+    return get_backend("tpu", batch_pow2=config.batch_pow2,
+                       n_miners=config.n_miners, kernel=config.kernel,
+                       mesh=mesh)
+
+
 def available() -> list[str]:
     from . import cpu  # noqa: F401
     try:

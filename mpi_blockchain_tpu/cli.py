@@ -103,11 +103,9 @@ def cmd_mine(args) -> int:
         from .models.fused import FusedMiner
         miner = FusedMiner(cfg, blocks_per_call=args.blocks_per_call,
                            mesh=mesh)
-    elif mesh is not None:
-        from .backend import get_backend
-        miner = Miner(cfg, backend=get_backend(
-            "tpu", batch_pow2=cfg.batch_pow2, n_miners=cfg.n_miners,
-            kernel=cfg.kernel, mesh=mesh))
+    elif mesh is not None:   # _init_world forces backend="tpu" with a mesh
+        from .backend import backend_from_config
+        miner = Miner(cfg, backend=backend_from_config(cfg, mesh=mesh))
     else:
         miner = Miner(cfg)
     if args.resume:

@@ -15,6 +15,37 @@ class ConfigError(ValueError):
     Subclasses ValueError so pre-existing `except ValueError` sites hold."""
 
 
+def extend_payload(data: bytes, extra_nonce: int) -> bytes:
+    """THE nonce-exhaustion rollover rule, shared by every mining driver.
+
+    When the full 2^32 nonce space holds no qualifying hash for a candidate
+    (SURVEY.md §0.2 #2 at difficulty ≳ 34), the search rolls over to a
+    fresh space by deterministically varying the payload — new payload ⇒
+    new data_hash ⇒ a genuinely independent search space. The rule is
+    byte-level and backend-independent so CPU, single-chip TPU, and the
+    fused mesh loop produce identical chains across a rollover:
+
+        extra_nonce == 0  ->  data unchanged (the common path; existing
+                              chains and pinned tips are unaffected)
+        extra_nonce == k  ->  data + b":xk"
+
+    Drivers try extra_nonce = 0, 1, 2, ... in order and accept the lowest
+    qualifying nonce of the FIRST space that holds one, which keeps the
+    winner a pure function of (tip, payload, difficulty).
+    """
+    if extra_nonce == 0:
+        return data
+    return data + b":x%d" % extra_nonce
+
+
+# Rollover liveness bound: after this many consecutive empty 2^32 spaces the
+# drivers raise instead of looping forever. Only an unsatisfiably high
+# difficulty (≳ 48 bits: P(space empty) ≈ exp(-2^(32-d)), so ~2^(d-32)
+# expected spaces) can hit it — that is a misconfiguration, and a loud error
+# beats an infinite silent sweep.
+MAX_EXTRA_NONCE = 1 << 16
+
+
 @dataclasses.dataclass(frozen=True)
 class MinerConfig:
     difficulty_bits: int = 16
@@ -30,8 +61,9 @@ class MinerConfig:
     def batch_size(self) -> int:
         return 1 << self.batch_pow2
 
-    def payload(self, height: int) -> bytes:
-        return f"{self.data_prefix}:{height}".encode()
+    def payload(self, height: int, extra_nonce: int = 0) -> bytes:
+        return extend_payload(f"{self.data_prefix}:{height}".encode(),
+                              extra_nonce)
 
 
 # The five BASELINE.json eval configs (SURVEY.md §6 measurement matrix).

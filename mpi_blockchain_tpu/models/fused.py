@@ -30,7 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import core
-from ..config import ConfigError
+from ..config import MAX_EXTRA_NONCE, ConfigError, extend_payload
 from ..ops.sha256_jnp import (IV, _bswap32, compress,
                               sha256d_words_from_midstate)
 from ..parallel.mesh import replicated_host_value
@@ -115,8 +115,14 @@ class FusedMiner:
     determinism contract is unchanged), one device call per k blocks.
     """
 
+    # Max fused calls in flight: enough that the device never drains while
+    # the host validates (validation of a 500-block batch is ~ms against a
+    # multi-second batch), small enough that a validation failure wastes at
+    # most a few stale batches of device work.
+    PIPELINE_DEPTH = 4
+
     def __init__(self, config, node_id: int = 0, blocks_per_call: int = 16,
-                 mesh=None, log_fn=None):
+                 mesh=None, log_fn=None, recovery_backend=None):
         if blocks_per_call < 1:
             raise ConfigError(
                 f"blocks_per_call must be >= 1, got {blocks_per_call}")
@@ -125,6 +131,10 @@ class FusedMiner:
         self.blocks_per_call = blocks_per_call
         self._mesh = mesh
         self._fns: dict[int, object] = {}
+        # Per-block backend for the nonce-exhaustion rollover path; built
+        # lazily (the path is ~unreachable below difficulty ~34).
+        # Injectable so tests can stage an exhaustion deterministically.
+        self._recovery = recovery_backend
         if log_fn is None:
             from ..utils.logging import block_logger
             log_fn = block_logger()
@@ -164,29 +174,114 @@ class FusedMiner:
         """Mines n_blocks; validates + appends every block in C++."""
         n = n_blocks if n_blocks is not None else self.config.n_blocks
         while n > 0:
-            k = min(n, self.blocks_per_call)
-            start_height = self.node.height
-            payloads = [self.config.payload(start_height + j + 1)
+            mined = self._mine_span(n)
+            n -= mined
+
+    def _mine_span(self, n: int) -> int:
+        """Dispatches ceil(n / blocks_per_call) fused device calls
+        back-to-back, then validates + appends batch by batch.
+
+        Pipelined: call i+1's prev_hash input is call i's tip_words OUTPUT
+        — a device array handed straight back in, so consecutive
+        dispatches queue with zero host round trips between them, and the
+        host's C++ validation of batch i overlaps device compute of batch
+        i+1 (dispatch latency under the axon tunnel is ~90 ms; the old
+        per-batch sync paid it once per batch). The in-flight window is
+        bounded (PIPELINE_DEPTH) so a mid-span validation failure leaves
+        at most a few stale batches executing, not the whole span.
+
+        Returns the number of blocks appended. Short only when a device
+        block fails C++ validation: the failing height is re-mined via the
+        shared extra-nonce rollover (or diagnosed as a kernel bug), the
+        now-stale in-flight batches are discarded, and the caller's loop
+        re-dispatches from the recovered tip.
+        """
+        start = self.node.height
+        prev = jnp.asarray(_words_be(self.node.tip_hash))
+        batches: list[tuple] = []
+        height = start
+        remaining = n
+
+        def dispatch_one():
+            nonlocal prev, height, remaining
+            k = min(remaining, self.blocks_per_call)
+            payloads = [self.config.payload(height + j + 1)
                         for j in range(k)]
             data_words = np.stack([_words_be(core.sha256d(p))
                                    for p in payloads])
-            prev_words = _words_be(self.node.tip_hash)
-            nonces, _ = self._fn(k)(jnp.asarray(prev_words),
-                                    jnp.asarray(data_words),
-                                    np.uint32(start_height))
+            nonces, prev = self._fn(k)(prev, jnp.asarray(data_words),
+                                       np.uint32(height))
+            batches.append((height, payloads, nonces))
+            height += k
+            remaining -= k
+
+        while remaining > 0 and len(batches) < self.PIPELINE_DEPTH:
+            dispatch_one()
+        while batches:
+            batch_height, payloads, nonces = batches.pop(0)
             nonces = replicated_host_value(nonces)
-            for j in range(k):
-                cand = self.node.make_candidate(payloads[j])
+            if remaining > 0:
+                dispatch_one()
+            for j, payload in enumerate(payloads):
+                cand = self.node.make_candidate(payload)
                 winner = core.set_nonce(cand, int(nonces[j]))
                 if not self.node.submit(winner):
-                    raise RuntimeError(
-                        f"fused miner produced an invalid block at height "
-                        f"{start_height + j + 1} (nonce {int(nonces[j])})")
+                    self._recover_block(batch_height + j + 1,
+                                        int(nonces[j]))
+                    return self.node.height - start
                 self._log({"event": "block_mined", "backend": "tpu-fused",
-                           "height": start_height + j + 1,
+                           "height": batch_height + j + 1,
                            "nonce": int(nonces[j]),
                            "hash": self.node.tip_hash.hex()})
-            n -= k
+        return self.node.height - start
+
+    def _recover_block(self, height: int, device_nonce: int) -> None:
+        """A device block failed C++ validation. Two possible causes: the
+        2^32 space genuinely holds no qualifier (the device cannot signal
+        not-found in-band — its sentinel nonce simply fails PoW here), or
+        a kernel bug. The authoritative per-block re-search distinguishes
+        them: a winner in the extra_nonce=0 space means the device missed
+        it (bug — raise with forensics); otherwise roll over through
+        fresh spaces exactly like Miner.mine_block, keeping the chain
+        identical across drivers."""
+        data = self.config.payload(height)
+        for extra_nonce in range(MAX_EXTRA_NONCE + 1):
+            cand = self.node.make_candidate(extend_payload(data,
+                                                           extra_nonce))
+            res = self._recovery_backend().search(
+                cand, self.config.difficulty_bits)
+            if res.nonce is None:
+                self._log({"event": "nonce_space_exhausted",
+                           "height": height,
+                           "extra_nonce": extra_nonce + 1})
+                continue
+            if extra_nonce == 0:
+                raise RuntimeError(
+                    f"fused device loop missed a qualifying nonce at "
+                    f"height {height}: device returned "
+                    f"{device_nonce:#010x}, re-search found "
+                    f"{res.nonce:#010x} — kernel bug, not exhaustion")
+            winner = core.set_nonce(cand, res.nonce)
+            if not self.node.submit(winner):
+                raise RuntimeError(
+                    f"rollover block failed validation at height {height} "
+                    f"(extra_nonce {extra_nonce}, nonce {res.nonce:#010x})")
+            self._log({"event": "block_mined",
+                       "backend": "tpu-fused/rollover", "height": height,
+                       "extra_nonce": extra_nonce, "nonce": res.nonce,
+                       "hash": self.node.tip_hash.hex()})
+            return
+        raise RuntimeError(
+            f"{MAX_EXTRA_NONCE} consecutive empty nonce spaces at height "
+            f"{height} — difficulty {self.config.difficulty_bits} is "
+            f"unsatisfiably high")
+
+    def _recovery_backend(self):
+        if self._recovery is None:
+            from ..backend import backend_from_config
+            self._recovery = backend_from_config(self.config,
+                                                 mesh=self._mesh)
+        return self._recovery
 
     def chain_hashes(self) -> list[str]:
         return [self.node.block_hash(i).hex()

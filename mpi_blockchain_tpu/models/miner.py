@@ -12,8 +12,8 @@ import time
 from typing import Callable
 
 from .. import core
-from ..backend import MinerBackend, get_backend
-from ..config import MinerConfig
+from ..backend import MinerBackend, backend_from_config
+from ..config import MAX_EXTRA_NONCE, MinerConfig, extend_payload
 from ..utils.logging import block_logger
 
 
@@ -39,31 +39,40 @@ class Miner:
                  log_fn: Callable[[dict], None] | None = None):
         self.config = config
         self.node = core.Node(config.difficulty_bits, node_id)
-        if backend is None:
-            if config.backend == "cpu":
-                backend = get_backend("cpu", n_ranks=config.n_miners,
-                                      batch_size=config.batch_size)
-            else:
-                backend = get_backend("tpu", batch_pow2=config.batch_pow2,
-                                      n_miners=config.n_miners,
-                                      kernel=config.kernel)
-        self.backend = backend
+        self.backend = (backend if backend is not None
+                        else backend_from_config(config))
         self.records: list[BlockRecord] = []
         self._log = log_fn if log_fn is not None else block_logger()
 
     def mine_block(self, data: bytes | None = None) -> BlockRecord:
-        """Mines and appends exactly one block on the current tip."""
+        """Mines and appends exactly one block on the current tip.
+
+        If the full 2^32 nonce space holds no qualifier, rolls over to a
+        fresh space via the shared extra-nonce rule (config.extend_payload)
+        — the same deterministic recovery every driver uses, so CPU / TPU /
+        fused chains stay identical across a rollover.
+        """
         height = self.node.height + 1
         if data is None:
             data = self.config.payload(height)
-        cand = self.node.make_candidate(data)
         t0 = time.perf_counter()
-        res = self.backend.search(cand, self.config.difficulty_bits)
-        wall_ms = (time.perf_counter() - t0) * 1e3
-        if res.nonce is None:
+        tried = 0
+        for extra_nonce in range(MAX_EXTRA_NONCE + 1):
+            cand = self.node.make_candidate(
+                extend_payload(data, extra_nonce))
+            res = self.backend.search(cand, self.config.difficulty_bits)
+            tried += res.hashes_tried
+            if res.nonce is not None:
+                break
+            self._log({"event": "nonce_space_exhausted", "height": height,
+                       "extra_nonce": extra_nonce + 1})
+        else:
             raise RuntimeError(
-                f"nonce space exhausted at height {height} "
-                f"(difficulty {self.config.difficulty_bits})")
+                f"{MAX_EXTRA_NONCE} consecutive empty nonce spaces at "
+                f"height {height} — difficulty "
+                f"{self.config.difficulty_bits} is unsatisfiably high")
+        wall_ms = (time.perf_counter() - t0) * 1e3
+        res = dataclasses.replace(res, hashes_tried=tried)
         winner = core.set_nonce(cand, res.nonce)
         if not self.node.submit(winner):
             raise RuntimeError(f"backend returned invalid block at {height}")

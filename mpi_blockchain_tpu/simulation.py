@@ -19,8 +19,8 @@ import dataclasses
 from typing import Callable
 
 from . import core
-from .backend import MinerBackend, get_backend
-from .config import ConfigError, MinerConfig
+from .backend import MinerBackend, backend_from_config
+from .config import ConfigError, MinerConfig, extend_payload
 
 
 @dataclasses.dataclass
@@ -48,13 +48,9 @@ class SimNode:
         self.config = config
         self.node = core.Node(config.difficulty_bits, node_id)
         if backend is None:  # honor the config's plugin choice (cli `sim
-            # --backend tpu` runs the device sweep inside each group)
-            if config.backend == "cpu":
-                backend = get_backend("cpu", batch_size=config.batch_size)
-            else:
-                backend = get_backend("tpu", batch_pow2=config.batch_pow2,
-                                      n_miners=config.n_miners,
-                                      kernel=config.kernel)
+            # --backend tpu` runs the device sweep inside each group);
+            # each group is ONE rank, so the cpu pool stays unthreaded
+            backend = backend_from_config(config, cpu_ranks=1)
         self.backend = backend
         self.stats = GroupStats()
         # Per-height search position, so a group resumes its sweep across
@@ -69,10 +65,9 @@ class SimNode:
 
     def _candidate(self) -> bytes:
         data = f"{self.config.data_prefix}:g{self.id}:" \
-               f"{self.node.height + 1}"
-        if self._extra_nonce:
-            data += f":x{self._extra_nonce}"
-        return self.node.make_candidate(data.encode())
+               f"{self.node.height + 1}".encode()
+        return self.node.make_candidate(
+            extend_payload(data, self._extra_nonce))
 
     def mine_step(self, nonce_budget: int) -> bytes | None:
         """Searches up to nonce_budget nonces; returns a mined header or None.
