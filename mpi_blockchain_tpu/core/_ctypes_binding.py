@@ -51,6 +51,13 @@ _lib.cc_node_receive.restype = ctypes.c_int
 _lib.cc_node_adopt_chain.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                      ctypes.c_uint64]
 _lib.cc_node_adopt_chain.restype = ctypes.c_int
+_lib.cc_node_adopt_suffix.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
+                                      ctypes.c_char_p, ctypes.c_uint64]
+_lib.cc_node_adopt_suffix.restype = ctypes.c_int
+_lib.cc_node_find.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+_lib.cc_node_find.restype = ctypes.c_int64
+_lib.cc_node_headers_from.argtypes = [ctypes.c_void_p, ctypes.c_uint64, _u8p]
+_lib.cc_node_headers_from.restype = ctypes.c_uint64
 _lib.cc_node_save.argtypes = [ctypes.c_void_p, _u8p]
 _lib.cc_node_save.restype = ctypes.c_uint64
 _lib.cc_node_load.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
@@ -167,6 +174,27 @@ class Node:
         blob = b"".join(headers80)
         return _lib.cc_node_adopt_chain(self._h, blob, len(headers80))
 
+    def adopt_suffix(self, anchor: int, headers80: list[bytes]) -> int:
+        """Suffix adoption above a common ancestor (O(suffix) sync)."""
+        blob = b"".join(headers80)
+        return _lib.cc_node_adopt_suffix(self._h, anchor, blob,
+                                         len(headers80))
+
+    def find(self, digest32: bytes) -> int:
+        """Height of this block hash on the chain, or -1 (O(1))."""
+        assert len(digest32) == 32
+        return _lib.cc_node_find(self._h, digest32)
+
+    def headers_from(self, from_height: int) -> list[bytes]:
+        """Headers for heights from_height+1..tip (suffix-sync wire
+        format; headers_from(0) == all_headers())."""
+        n = max(self.height - from_height, 0)
+        out = _out_buf(n * HEADER_SIZE)
+        got = _lib.cc_node_headers_from(self._h, from_height, out)
+        blob = bytes(out)
+        return [blob[i * HEADER_SIZE:(i + 1) * HEADER_SIZE]
+                for i in range(got)]
+
     def save(self) -> bytes:
         out = _out_buf((self.height + 1) * HEADER_SIZE)
         n = _lib.cc_node_save(self._h, out)
@@ -182,4 +210,4 @@ class Node:
 
     def all_headers(self) -> list[bytes]:
         """Headers for heights 1..tip (the adopt_chain wire format)."""
-        return [self.block_header(i) for i in range(1, self.height + 1)]
+        return self.headers_from(0)

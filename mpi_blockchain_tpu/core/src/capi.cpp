@@ -119,6 +119,35 @@ int cc_node_adopt_chain(void* node, const uint8_t* headers, uint64_t n) {
   return int(static_cast<Node*>(node)->adopt_chain(hs));
 }
 
+// Suffix adoption above a common ancestor at `anchor` (O(suffix) sync).
+// headers = n concatenated 80-byte headers for heights anchor+1..anchor+n.
+// Returns the RecvResult enum value (kReorged on adoption).
+int cc_node_adopt_suffix(void* node, uint64_t anchor, const uint8_t* headers,
+                         uint64_t n) {
+  std::vector<BlockHeader> hs;
+  hs.reserve(n);
+  for (uint64_t i = 0; i < n; ++i)
+    hs.push_back(BlockHeader::deserialize(headers + i * kHeaderSize));
+  return int(static_cast<Node*>(node)->adopt_suffix(anchor, hs));
+}
+
+// Height of the block with this hash on the node's chain, or -1 (O(1)
+// via the chain's hash index) — the sync protocol's common-ancestor probe.
+int64_t cc_node_find(void* node, const uint8_t hash32[32]) {
+  return static_cast<Node*>(node)->chain().find(hash32);
+}
+
+// Serves the headers ABOVE from_height (heights from_height+1..tip) as
+// concatenated 80-byte headers into `out` (caller allocates
+// (height - from_height)*80 bytes). Returns the number of headers written;
+// 0 when from_height >= height.
+uint64_t cc_node_headers_from(void* node, uint64_t from_height, uint8_t* out) {
+  std::vector<uint8_t> bytes =
+      static_cast<Node*>(node)->chain().headers_from(from_height);
+  std::memcpy(out, bytes.data(), bytes.size());
+  return bytes.size() / kHeaderSize;
+}
+
 // Writes the whole chain (genesis..tip) as concatenated headers into `out`
 // (caller allocates (height+1)*80 bytes). Returns the number of headers.
 uint64_t cc_node_save(void* node, uint8_t* out) {

@@ -100,20 +100,26 @@ bool Chain::append(const BlockHeader& header) {
 }
 
 bool Chain::try_adopt(const std::vector<BlockHeader>& headers) {
-  if (headers.size() <= height()) return false;  // not strictly longer
+  return try_adopt_from(0, headers);
+}
+
+bool Chain::try_adopt_from(uint64_t anchor,
+                           const std::vector<BlockHeader>& headers) {
+  if (anchor > height()) return false;
+  if (anchor + headers.size() <= height()) return false;  // not strictly longer
   // Fork point: the longest prefix of `headers` byte-identical to our own
-  // blocks 1..height(). Shared blocks were fully validated when first
-  // adopted, so only the divergent suffix needs hashing and validation —
-  // adopt cost is O(suffix), not O(height).
+  // blocks anchor+1..height(). Shared blocks were fully validated when
+  // first adopted, so only the divergent suffix needs hashing and
+  // validation — adopt cost is O(suffix), not O(height).
   uint8_t ours[kHeaderSize], theirs[kHeaderSize];
   size_t fork = 0;  // number of leading shared headers
-  while (fork + 1 < blocks_.size()) {
-    blocks_[fork + 1].header.serialize(ours);
+  while (anchor + fork + 1 < blocks_.size() && fork < headers.size()) {
+    blocks_[anchor + fork + 1].header.serialize(ours);
     headers[fork].serialize(theirs);
     if (std::memcmp(ours, theirs, kHeaderSize) != 0) break;
     ++fork;
   }
-  const Block* parent = &blocks_[fork];
+  const Block* parent = &blocks_[anchor + fork];
   std::vector<Block> suffix;
   suffix.reserve(headers.size() - fork);
   for (size_t i = fork; i < headers.size(); ++i) {
@@ -121,7 +127,7 @@ bool Chain::try_adopt(const std::vector<BlockHeader>& headers) {
     suffix.push_back(Block::from_header(headers[i], parent->height + 1));
     parent = &suffix.back();
   }
-  rollback_to(fork);
+  rollback_to(anchor + fork);
   for (const Block& b : suffix) {
     blocks_.push_back(b);
     index_add(blocks_.back());
@@ -140,6 +146,16 @@ std::vector<uint8_t> Chain::save() const {
   std::vector<uint8_t> out(blocks_.size() * kHeaderSize);
   for (size_t i = 0; i < blocks_.size(); ++i)
     blocks_[i].header.serialize(out.data() + i * kHeaderSize);
+  return out;
+}
+
+std::vector<uint8_t> Chain::headers_from(uint64_t from_height) const {
+  if (from_height >= height()) return {};
+  uint64_t n = height() - from_height;
+  std::vector<uint8_t> out(n * kHeaderSize);
+  for (uint64_t i = 0; i < n; ++i)
+    blocks_[from_height + 1 + i].header.serialize(out.data() +
+                                                  i * kHeaderSize);
   return out;
 }
 
@@ -192,6 +208,15 @@ RecvResult Node::adopt_chain(const std::vector<BlockHeader>& headers) {
   if (headers.size() <= chain_.height()) return RecvResult::kIgnoredShorter;
   return chain_.try_adopt(headers) ? RecvResult::kReorged
                                    : RecvResult::kInvalid;
+}
+
+RecvResult Node::adopt_suffix(uint64_t anchor,
+                              const std::vector<BlockHeader>& headers) {
+  if (anchor > chain_.height()) return RecvResult::kInvalid;
+  if (anchor + headers.size() <= chain_.height())
+    return RecvResult::kIgnoredShorter;
+  return chain_.try_adopt_from(anchor, headers) ? RecvResult::kReorged
+                                                : RecvResult::kInvalid;
 }
 
 }  // namespace chaincore

@@ -86,6 +86,14 @@ class Chain {
   // adopted, so only the divergent suffix is hashed and checked.
   bool try_adopt(const std::vector<BlockHeader>& headers);
 
+  // Suffix form of the longest-chain rule (SURVEY.md §3.3 "request chain
+  // (suffix) from r"): `headers` are heights anchor+1..anchor+n, children
+  // of OUR block at `anchor` (a common ancestor the sync protocol
+  // established). Adopts iff fully valid and the result is strictly
+  // longer. Makes fork-heal TRANSFER O(suffix), matching the O(suffix)
+  // validation try_adopt already has; try_adopt == try_adopt_from(0, ...).
+  bool try_adopt_from(uint64_t anchor, const std::vector<BlockHeader>& headers);
+
   // Drops blocks above `new_height` (reorg rollback primitive).
   void rollback_to(uint64_t new_height);
 
@@ -95,6 +103,10 @@ class Chain {
 
   // Serialization: concatenated 80-byte headers (heights 0..tip).
   std::vector<uint8_t> save() const;
+  // Concatenated headers for heights from_height+1..tip (the suffix-sync
+  // wire format; empty when from_height >= height()). The ONE serve-side
+  // implementation both bindings expose.
+  std::vector<uint8_t> headers_from(uint64_t from_height) const;
   // Rebuilds a chain from saved bytes; validates everything above genesis.
   // Returns false if the bytes do not form a valid chain.
   static bool load(const std::vector<uint8_t>& bytes, uint32_t difficulty_bits,
@@ -146,6 +158,12 @@ class Node {
 
   // Longest-chain adoption of a peer's full chain (heights 1..n).
   RecvResult adopt_chain(const std::vector<BlockHeader>& headers);
+
+  // Suffix adoption above a common ancestor at `anchor` (the O(suffix)
+  // sync protocol's entry point). kReorged on adoption, kIgnoredShorter
+  // when not strictly longer, kInvalid otherwise.
+  RecvResult adopt_suffix(uint64_t anchor,
+                          const std::vector<BlockHeader>& headers);
 
   Chain& mutable_chain() { return chain_; }
 

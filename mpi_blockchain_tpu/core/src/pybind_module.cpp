@@ -165,6 +165,34 @@ PYBIND11_MODULE(chaincore_pb, m) {
                hs.push_back(BlockHeader::deserialize(data8(check80(h))));
              return int(n.adopt_chain(hs));
            })
+      .def("adopt_suffix",
+           [](Node& n, uint64_t anchor,
+              const std::vector<std::string>& headers80) {
+             // Suffix adoption above a common ancestor (O(suffix) sync).
+             std::vector<BlockHeader> hs;
+             hs.reserve(headers80.size());
+             for (const std::string& h : headers80)
+               hs.push_back(BlockHeader::deserialize(data8(check80(h))));
+             return int(n.adopt_suffix(anchor, hs));
+           })
+      .def("find",
+           [](const Node& n, const std::string& digest32) {
+             // Height of this block hash on the chain, or -1 (O(1)).
+             if (digest32.size() != 32)
+               throw py::value_error("digest must be 32 bytes");
+             return n.chain().find(data8(digest32));
+           })
+      .def("headers_from",
+           [](const Node& n, uint64_t from_height) {
+             // Headers for heights from_height+1..tip (the suffix-sync
+             // wire format; headers_from(0) == all_headers()).
+             std::vector<uint8_t> bytes = n.chain().headers_from(from_height);
+             std::vector<py::bytes> out;
+             out.reserve(bytes.size() / kHeaderSize);
+             for (size_t i = 0; i < bytes.size(); i += kHeaderSize)
+               out.push_back(to_bytes(bytes.data() + i, kHeaderSize));
+             return out;
+           })
       .def("save",
            [](const Node& n) {
              std::vector<uint8_t> bytes = n.chain().save();
@@ -185,14 +213,13 @@ PYBIND11_MODULE(chaincore_pb, m) {
              n.mutable_chain().rollback_to(new_height);
            })
       .def("all_headers", [](const Node& n) {
-        // Headers for heights 1..tip (the adopt_chain wire format).
+        // Headers for heights 1..tip (the adopt_chain wire format) ==
+        // headers_from(0), through the same shared Chain implementation.
+        std::vector<uint8_t> bytes = n.chain().headers_from(0);
         std::vector<py::bytes> out;
-        out.reserve(n.height());
-        uint8_t buf[kHeaderSize];
-        for (uint64_t h = 1; h <= n.height(); ++h) {
-          n.chain().at(h).header.serialize(buf);
-          out.push_back(to_bytes(buf, kHeaderSize));
-        }
+        out.reserve(bytes.size() / kHeaderSize);
+        for (size_t i = 0; i < bytes.size(); i += kHeaderSize)
+          out.push_back(to_bytes(bytes.data() + i, kHeaderSize));
         return out;
       });
 }

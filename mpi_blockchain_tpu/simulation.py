@@ -34,9 +34,32 @@ class _Message:
 @dataclasses.dataclass
 class GroupStats:
     blocks_mined: int = 0
-    blocks_accepted_from_peers: int = 0
+    blocks_accepted_from_peers: int = 0   # via direct tip extension (receive)
+    blocks_adopted: int = 0               # gained via suffix/chain adoption
     reorgs: int = 0
-    reorged_away_blocks: int = 0   # own blocks lost to adoption of a longer chain
+    reorged_away_blocks: int = 0   # blocks actually rolled back by adoptions
+    headers_fetched: int = 0       # sync-protocol transfer accounting
+
+    def conserved_height(self) -> int:
+        """Every chain mutation is accounted, so a node's height is exactly
+        mined + accepted + adopted - reorged_away (the fuzz invariant)."""
+        return (self.blocks_mined + self.blocks_accepted_from_peers
+                + self.blocks_adopted - self.reorged_away_blocks)
+
+
+def locator_heights(tip: int) -> list[int]:
+    """Bitcoin-style block locator: the last 10 heights step 1, then
+    exponentially widening gaps, always ending at genesis. O(log height)
+    entries; the first entry a peer recognizes bounds the common ancestor
+    from below, making fork-heal transfer O(suffix), not O(height)."""
+    heights, step, h = [], 1, tip
+    while h > 0:
+        heights.append(h)
+        if len(heights) >= 10:
+            step *= 2
+        h -= step
+    heights.append(0)
+    return heights
 
 
 class SimNode:
@@ -102,17 +125,58 @@ class SimNode:
         self._tip_at_start = self.node.tip_hash
         return winner
 
-    def receive(self, header80: bytes, fetch_chain: Callable[[], list[bytes]]
-                ) -> None:
+    # ---- sync protocol (SURVEY.md §3.3: "request chain (suffix)") -------
+
+    def find_anchor(self, locator: list[tuple[int, bytes]]) -> int:
+        """Serve side: highest locator entry present on OUR chain (O(1)
+        each via the C++ hash index). Heights are structural (timestamp ==
+        height), so a common block sits at the same height on both chains;
+        genesis is always common, so this never fails for same-difficulty
+        peers."""
+        for height, digest in locator:          # descending heights
+            if self.node.find(digest) == height:
+                return height
+        return 0
+
+    def receive(self, header80: bytes, peer: "SimNode") -> None:
         """Consensus on a peer announcement (SURVEY.md §3.3)."""
         r = self.node.receive(header80)
         if r == core.RecvResult.APPENDED:
             self.stats.blocks_accepted_from_peers += 1
         elif r == core.RecvResult.STALE_OR_FORK:
-            own_height = self.node.height
-            if self.node.adopt_chain(fetch_chain()) == core.RecvResult.REORGED:
+            self._sync_from(peer)
+
+    def _sync_from(self, peer: "SimNode") -> None:
+        """O(suffix) longest-chain sync: send a block locator, fetch only
+        the peer's headers above the common ancestor, adopt the suffix.
+        Falls back to a genesis-anchored (full-chain) fetch if the suffix
+        unexpectedly fails to validate — the locator guarantees the anchor
+        is common, so the fallback is pure defense in depth."""
+        own_height = self.node.height
+        locator = [(h, self.node.block_hash(h))
+                   for h in locator_heights(own_height)]
+        anchor = peer.find_anchor(locator)
+        suffix = peer.node.headers_from(anchor)
+        self.stats.headers_fetched += len(suffix)
+        res = self._adopt(anchor, suffix, own_height)
+        if res == core.RecvResult.INVALID and anchor > 0:
+            full = peer.node.all_headers()
+            self.stats.headers_fetched += len(full)
+            self._adopt(0, full, own_height)
+
+    def _adopt(self, anchor: int, suffix: list[bytes],
+               own_height: int) -> int:
+        old = [self.node.block_hash(i)
+               for i in range(anchor + 1, own_height + 1)]
+        res = self.node.adopt_suffix(anchor, suffix)
+        if res == core.RecvResult.REORGED:
+            rolled_back = sum(1 for d in old if self.node.find(d) < 0)
+            self.stats.blocks_adopted += (self.node.height - own_height
+                                          + rolled_back)
+            if rolled_back:
                 self.stats.reorgs += 1
-                self.stats.reorged_away_blocks += own_height
+                self.stats.reorged_away_blocks += rolled_back
+        return res
 
 
 class Network:
@@ -172,7 +236,7 @@ class Network:
                         self.queue.append(dataclasses.replace(
                             m, deliver_step=self.partitioned_until))
                     continue
-                node.receive(m.header80, sender_node.node.all_headers)
+                node.receive(m.header80, sender_node)
 
     def step(self, nonce_budget: int = 1 << 16) -> None:
         """One simulation step: deliver, then every group mines a slice."""

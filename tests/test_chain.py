@@ -183,3 +183,82 @@ def test_rollback_prunes_index():
     a.rollback(1)
     assert a.receive(dropped) == core.RecvResult.APPENDED
     assert a.height == 2
+
+
+# ---- suffix sync surface (O(suffix) fork heal; SURVEY.md §3.3) ----------
+
+
+def test_find_is_hash_index():
+    a = core.Node(DIFF, 0)
+    for p in (b"f1", b"f2", b"f3"):
+        a.submit(mine_on(a, p))
+    for h in range(a.height + 1):
+        assert a.find(a.block_hash(h)) == h
+    assert a.find(b"\x00" * 32) == -1
+
+
+def test_headers_from_serves_suffix():
+    a = core.Node(DIFF, 0)
+    for p in (b"h1", b"h2", b"h3"):
+        a.submit(mine_on(a, p))
+    assert a.headers_from(0) == a.all_headers()
+    assert a.headers_from(1) == [a.block_header(2), a.block_header(3)]
+    assert a.headers_from(3) == []
+    assert a.headers_from(99) == []
+
+
+def test_adopt_suffix_pure_extension():
+    """Receiver's tip is the peer's ancestor: no rollback, just append."""
+    a, b = core.Node(DIFF, 0), core.Node(DIFF, 1)
+    for p in (b"s1", b"s2", b"s3"):
+        a.submit(mine_on(a, p))
+    assert b.receive(a.block_header(1)) == core.RecvResult.APPENDED
+    assert b.adopt_suffix(1, a.headers_from(1)) == core.RecvResult.REORGED
+    assert b.height == 3 and b.tip_hash == a.tip_hash
+
+
+def test_adopt_suffix_with_rollback():
+    """Common ancestor below both tips: the divergent suffix is replaced."""
+    a, b = core.Node(DIFF, 0), core.Node(DIFF, 1)
+    shared = mine_on(a, b"common")
+    a.submit(shared)
+    b.submit(shared)
+    b.submit(mine_on(b, b"b-side"))                 # b forks: height 2
+    for p in (b"a2", b"a3", b"a4"):                 # a wins: height 4
+        a.submit(mine_on(a, p))
+    assert b.adopt_suffix(1, a.headers_from(1)) == core.RecvResult.REORGED
+    assert b.height == 4 and b.tip_hash == a.tip_hash
+
+
+def test_adopt_suffix_rejects_shorter_and_bad_anchor():
+    a, b = core.Node(DIFF, 0), core.Node(DIFF, 1)
+    for p in (b"r1", b"r2"):
+        a.submit(mine_on(a, p))
+        b.submit(mine_on(b, p + b"'"))  # different payloads: genuine fork
+    # Same length is not strictly longer.
+    assert b.adopt_suffix(0, a.all_headers()) \
+        == core.RecvResult.IGNORED_SHORTER
+    # Anchor beyond our height is invalid, not a crash.
+    assert b.adopt_suffix(99, a.all_headers()) == core.RecvResult.INVALID
+    # A strictly-longer suffix whose parent linkage doesn't match our
+    # anchor block (b's block 1 != a's block 1): invalid, chain unchanged.
+    a.submit(mine_on(a, b"r3"))
+    tip_before = b.tip_hash
+    assert b.adopt_suffix(1, a.headers_from(1)) == core.RecvResult.INVALID
+    assert b.tip_hash == tip_before and b.height == 2
+
+
+def test_adopt_suffix_skips_shared_prefix():
+    """A suffix that partially overlaps our chain revalidates only the
+    divergent tail (and equals a full adopt_chain outcome)."""
+    a, b = core.Node(DIFF, 0), core.Node(DIFF, 1)
+    for p in (b"p1", b"p2"):
+        hdr = mine_on(a, p)
+        a.submit(hdr)
+        b.receive(hdr)
+    b.submit(mine_on(b, b"b-tail"))
+    for p in (b"a3", b"a4"):
+        a.submit(mine_on(a, p))
+    # Anchor at 1: the suffix re-sends height 2 (shared) + the new tail.
+    assert b.adopt_suffix(1, a.headers_from(1)) == core.RecvResult.REORGED
+    assert b.tip_hash == a.tip_hash and b.height == 4
