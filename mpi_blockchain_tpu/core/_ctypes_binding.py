@@ -182,7 +182,9 @@ class Node:
 
     def find(self, digest32: bytes) -> int:
         """Height of this block hash on the chain, or -1 (O(1))."""
-        assert len(digest32) == 32
+        if len(digest32) != 32:    # ValueError like the pybind11 binding;
+            # an assert would vanish under -O and pass a short buffer to C
+            raise ValueError("digest must be 32 bytes")
         return _lib.cc_node_find(self._h, digest32)
 
     def headers_from(self, from_height: int) -> list[bytes]:

@@ -144,7 +144,7 @@ int64_t cc_node_find(void* node, const uint8_t hash32[32]) {
 uint64_t cc_node_headers_from(void* node, uint64_t from_height, uint8_t* out) {
   std::vector<uint8_t> bytes =
       static_cast<Node*>(node)->chain().headers_from(from_height);
-  std::memcpy(out, bytes.data(), bytes.size());
+  if (!bytes.empty()) std::memcpy(out, bytes.data(), bytes.size());
   return bytes.size() / kHeaderSize;
 }
 

@@ -50,6 +50,25 @@ uint64_t checked_height(const Node& n, int64_t height) {
   return uint64_t(height);
 }
 
+// Concatenated 80-byte headers -> list[bytes] (the suffix-sync wire format).
+std::vector<py::bytes> to_header_list(const std::vector<uint8_t>& bytes) {
+  std::vector<py::bytes> out;
+  out.reserve(bytes.size() / kHeaderSize);
+  for (size_t i = 0; i < bytes.size(); i += kHeaderSize)
+    out.push_back(to_bytes(bytes.data() + i, kHeaderSize));
+  return out;
+}
+
+// list[bytes] (80 each) -> parsed headers, validating lengths.
+std::vector<BlockHeader> parse_headers(
+    const std::vector<std::string>& headers80) {
+  std::vector<BlockHeader> hs;
+  hs.reserve(headers80.size());
+  for (const std::string& h : headers80)
+    hs.push_back(BlockHeader::deserialize(data8(check80(h))));
+  return hs;
+}
+
 // Sequential lowest-nonce sweep (same contract as capi.cpp cc_search; both
 // delegate to the shared chaincore::midstate_sweep). GIL released: the CPU
 // miner_backend runs this from 8 "rank" threads.
@@ -159,21 +178,13 @@ PYBIND11_MODULE(chaincore_pb, m) {
            })
       .def("adopt_chain",
            [](Node& n, const std::vector<std::string>& headers80) {
-             std::vector<BlockHeader> hs;
-             hs.reserve(headers80.size());
-             for (const std::string& h : headers80)
-               hs.push_back(BlockHeader::deserialize(data8(check80(h))));
-             return int(n.adopt_chain(hs));
+             return int(n.adopt_chain(parse_headers(headers80)));
            })
       .def("adopt_suffix",
            [](Node& n, uint64_t anchor,
               const std::vector<std::string>& headers80) {
              // Suffix adoption above a common ancestor (O(suffix) sync).
-             std::vector<BlockHeader> hs;
-             hs.reserve(headers80.size());
-             for (const std::string& h : headers80)
-               hs.push_back(BlockHeader::deserialize(data8(check80(h))));
-             return int(n.adopt_suffix(anchor, hs));
+             return int(n.adopt_suffix(anchor, parse_headers(headers80)));
            })
       .def("find",
            [](const Node& n, const std::string& digest32) {
@@ -186,12 +197,7 @@ PYBIND11_MODULE(chaincore_pb, m) {
            [](const Node& n, uint64_t from_height) {
              // Headers for heights from_height+1..tip (the suffix-sync
              // wire format; headers_from(0) == all_headers()).
-             std::vector<uint8_t> bytes = n.chain().headers_from(from_height);
-             std::vector<py::bytes> out;
-             out.reserve(bytes.size() / kHeaderSize);
-             for (size_t i = 0; i < bytes.size(); i += kHeaderSize)
-               out.push_back(to_bytes(bytes.data() + i, kHeaderSize));
-             return out;
+             return to_header_list(n.chain().headers_from(from_height));
            })
       .def("save",
            [](const Node& n) {
@@ -215,11 +221,6 @@ PYBIND11_MODULE(chaincore_pb, m) {
       .def("all_headers", [](const Node& n) {
         // Headers for heights 1..tip (the adopt_chain wire format) ==
         // headers_from(0), through the same shared Chain implementation.
-        std::vector<uint8_t> bytes = n.chain().headers_from(0);
-        std::vector<py::bytes> out;
-        out.reserve(bytes.size() / kHeaderSize);
-        for (size_t i = 0; i < bytes.size(); i += kHeaderSize)
-          out.push_back(to_bytes(bytes.data() + i, kHeaderSize));
-        return out;
+        return to_header_list(n.chain().headers_from(0));
       });
 }
