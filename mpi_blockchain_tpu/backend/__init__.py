@@ -70,7 +70,7 @@ def backend_from_config(config, cpu_ranks: int | None = None,
                            n_ranks=(config.n_miners if cpu_ranks is None
                                     else cpu_ranks),
                            batch_size=config.batch_size)
-    return get_backend("tpu", batch_pow2=config.batch_pow2,
+    return get_backend("tpu", batch_pow2=config.effective_batch_pow2,
                        n_miners=config.n_miners, kernel=config.kernel,
                        mesh=mesh)
 

@@ -19,6 +19,16 @@ import time
 from .config import ConfigError, MinerConfig, PRESETS
 
 
+def _batch_pow2_arg(s: str):
+    if s == "auto":
+        return s
+    try:
+        return int(s)
+    except ValueError:
+        raise argparse.ArgumentTypeError(
+            f"expected an integer or 'auto', got {s!r}") from None
+
+
 def _add_config_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--preset", choices=sorted(PRESETS),
                    help="named BASELINE config (overrides other flags)")
@@ -30,8 +40,9 @@ def _add_config_args(p: argparse.ArgumentParser) -> None:
     p.add_argument("--backend", choices=["cpu", "tpu"], default="cpu")
     p.add_argument("--kernel", choices=["auto", "jnp", "pallas"],
                    default="auto")
-    p.add_argument("--batch-pow2", type=int, default=20,
-                   help="log2 nonces per device per round")
+    p.add_argument("--batch-pow2", type=_batch_pow2_arg, default=20,
+                   help="log2 nonces per device per round, or 'auto' to "
+                        "track the difficulty (clamped to [13, 24])")
 
 
 def _config_from(args) -> MinerConfig:
@@ -234,10 +245,11 @@ def cmd_bench(args) -> int:
                              blocks_per_call=args.blocks_per_call,
                              n_miners=args.miners, kernel=args.kernel)
     else:
+        # The raw sweep has no difficulty to track, so "auto" falls back
+        # to the dispatch-amortized default.
+        pow2 = args.batch_pow2 if isinstance(args.batch_pow2, int) else 28
         result = run_bench(backend=args.backend, seconds=args.seconds,
-                           batch_pow2=(args.batch_pow2
-                                       if args.batch_pow2 is not None
-                                       else 28),
+                           batch_pow2=pow2,
                            n_miners=args.miners, kernel=args.kernel)
     print(json.dumps(result, sort_keys=True))
     return 0
@@ -292,7 +304,8 @@ def main(argv: list[str] | None = None) -> int:
     # is dominated by per-dispatch overhead, not the kernel (see
     # ops/sha256_pallas.py); bench_tpu clamps to 2^22 on CPU-only hosts.
     # chain default 24: the early-exit sweet spot at difficulty 24.
-    p_bench.add_argument("--batch-pow2", type=int, default=None)
+    # "auto" (chain mode) sizes the batch to the difficulty.
+    p_bench.add_argument("--batch-pow2", type=_batch_pow2_arg, default=None)
     p_bench.add_argument("--miners", type=int, default=1)
     p_bench.add_argument("--kernel", choices=["auto", "jnp", "pallas"],
                          default="auto")
@@ -314,7 +327,7 @@ def main(argv: list[str] | None = None) -> int:
     p_sim.add_argument("--backend", choices=["cpu", "tpu"], default="cpu")
     p_sim.add_argument("--kernel", choices=["auto", "jnp", "pallas"],
                        default="auto")
-    p_sim.add_argument("--batch-pow2", type=int, default=12)
+    p_sim.add_argument("--batch-pow2", type=_batch_pow2_arg, default=12)
     p_sim.add_argument("--partition-steps", type=int, default=30,
                        help="steps the 2 groups stay partitioned")
     p_sim.add_argument("--nonce-budget-pow2", type=int, default=8,

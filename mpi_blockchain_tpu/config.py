@@ -50,16 +50,40 @@ MAX_EXTRA_NONCE = 1 << 16
 class MinerConfig:
     difficulty_bits: int = 16
     n_blocks: int = 10
-    batch_pow2: int = 20          # log2(per-device nonces per sweep round)
+    batch_pow2: int | str = 20    # log2(per-device nonces per sweep round),
+    #                               or "auto" to track the difficulty
     n_miners: int = 1             # mesh axis size (devices or CPU ranks)
     backend: str = "tpu"          # miner_backend plugin: {"cpu", "tpu"}
     kernel: str = "auto"          # tpu sweep kernel: {"auto", "jnp", "pallas"}
     seed: int = 0                 # reserved (search is deterministic)
     data_prefix: str = "block"    # payload = f"{data_prefix}:{height}"
 
+    def __post_init__(self):
+        if self.batch_pow2 != "auto" and not (
+                isinstance(self.batch_pow2, int)
+                and 0 <= self.batch_pow2 <= 32):
+            raise ConfigError(
+                f"batch_pow2 must be an int in [0, 32] or 'auto', "
+                f"got {self.batch_pow2!r}")
+
+    @property
+    def effective_batch_pow2(self) -> int:
+        """batch_pow2 with "auto" resolved: ≈ one expected winner per
+        round (batch ≈ 2^difficulty), clamped to [13, 24] — 2^13 is one
+        Pallas tile (the smallest flagship-kernel batch), 2^24 bounds the
+        early-exit overshoot. The difficulty-scaling curve (BASELINE.md)
+        showed the fixed per-round cost dominating when a fixed 2^24
+        batch vastly oversizes low difficulties (47.5 MH/s effective at
+        diff 16 vs ~1000 at 24); tracking the difficulty right-sizes the
+        round without changing any tip (round size never affects the
+        lowest-qualifying-nonce winner)."""
+        if self.batch_pow2 == "auto":
+            return min(max(self.difficulty_bits, 13), 24)
+        return self.batch_pow2
+
     @property
     def batch_size(self) -> int:
-        return 1 << self.batch_pow2
+        return 1 << self.effective_batch_pow2
 
     def payload(self, height: int, extra_nonce: int = 0) -> bytes:
         return extend_payload(f"{self.data_prefix}:{height}".encode(),

@@ -144,7 +144,8 @@ class FusedMiner:
         fn = self._fns.get(k)
         if fn is None:
             fn = make_fused_miner(
-                k, self.config.batch_pow2, self.config.difficulty_bits,
+                k, self.config.effective_batch_pow2,
+                self.config.difficulty_bits,
                 n_miners=self.config.n_miners, mesh=self._mesh,
                 kernel=self.config.kernel)
             self._fns[k] = fn

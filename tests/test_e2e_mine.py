@@ -66,3 +66,46 @@ def test_difficulty_zero_identical_chains():
                            kernel="jnp", batch_pow2=10))
     assert cpu.chain_hashes() == tpu.chain_hashes()
     assert all(rec.nonce == 0 for rec in cpu.records)
+
+
+def test_batch_pow2_auto_resolution():
+    from mpi_blockchain_tpu.config import ConfigError, MinerConfig
+    import pytest as _pytest
+
+    assert MinerConfig(difficulty_bits=16,
+                       batch_pow2="auto").effective_batch_pow2 == 16
+    assert MinerConfig(difficulty_bits=8,
+                       batch_pow2="auto").effective_batch_pow2 == 13
+    assert MinerConfig(difficulty_bits=30,
+                       batch_pow2="auto").effective_batch_pow2 == 24
+    cfg = MinerConfig(difficulty_bits=16, batch_pow2="auto")
+    assert cfg.batch_size == 1 << 16
+    # Explicit ints resolve to themselves.
+    assert MinerConfig(batch_pow2=12).effective_batch_pow2 == 12
+    with _pytest.raises(ConfigError, match="batch_pow2"):
+        MinerConfig(batch_pow2="big")
+    with _pytest.raises(ConfigError, match="batch_pow2"):
+        MinerConfig(batch_pow2=33)
+
+
+def test_batch_pow2_auto_tip_unchanged():
+    """Round size never affects the lowest-qualifying-nonce winner: auto
+    and explicit batches mine byte-identical chains (per-block and
+    fused)."""
+    from mpi_blockchain_tpu.config import MinerConfig
+    from mpi_blockchain_tpu.models.fused import FusedMiner
+    from mpi_blockchain_tpu.models.miner import Miner
+
+    base = dict(difficulty_bits=10, n_blocks=3, backend="tpu",
+                kernel="jnp")
+    explicit = Miner(MinerConfig(batch_pow2=13, **base),
+                     log_fn=lambda d: None)
+    explicit.mine_chain()
+    auto = Miner(MinerConfig(batch_pow2="auto", **base),
+                 log_fn=lambda d: None)
+    auto.mine_chain()
+    assert auto.chain_hashes() == explicit.chain_hashes()
+    fused_auto = FusedMiner(MinerConfig(batch_pow2="auto", **base),
+                            blocks_per_call=2, log_fn=lambda d: None)
+    fused_auto.mine_chain()
+    assert fused_auto.chain_hashes() == explicit.chain_hashes()
