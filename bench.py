@@ -1,9 +1,13 @@
 """Benchmark harness: prints ONE JSON line with the primary metric.
 
 Metric (BASELINE.json): hashes/sec/chip on the TPU sweep, with vs_baseline =
-TPU total rate / 8-rank CPU total rate (the mpirun -np 8 stand-in: 8 C++
-threads running the scalar miner loop with the GIL released — OpenMPI is not
-in this image; documented in BASELINE.md).
+TPU total rate / the PINNED canonical 8-rank CPU rate (1.78 MH/s, round 1's
+mpirun -np 8 stand-in: 8 C++ threads running the scalar miner loop with the
+GIL released — OpenMPI is not in this image; documented in BASELINE.md). The
+same-run CPU sample is still measured and reported in detail
+(vs_cpu_same_run), but the headline denominator no longer load-drifts.
+Official device sections (sweep, chain) are best-of-2 with the spread on
+the record — the tunnel can inflate a single run >10x.
 
 Round-1 postmortem baked in: the axon tunnel can wedge at device init, and a
 single end-of-run print lost every device number when the watchdog fired
@@ -41,6 +45,12 @@ sys.path.insert(0, str(REPO))
 
 CACHE_PATH = REPO / "BENCH_CACHE.json"
 
+# The pinned round-1 8-rank CPU baseline (mpirun -np 8 stand-in, BASELINE.md
+# measurement matrix). The headline vs_baseline divides by THIS constant so
+# the field is comparable across rounds; the same-run CPU sample (whose
+# load-varying 0.8-1.8 MH/s drifted the old headline) is demoted to detail.
+CANONICAL_CPU_NP8_HS = 1.78e6
+
 # Marker string present in every device-child cmdline so a stale-process
 # sweep can find leftovers from earlier runs: MBT_BENCH_SECTION.
 _DEVICE_CODE = """
@@ -50,19 +60,37 @@ def emit(section, payload):
     print("BENCH_JSON:" + json.dumps({"section": section,
                                       "payload": payload}), flush=True)
 import jax
-from mpi_blockchain_tpu.bench_lib import bench_chain, bench_tpu
+from mpi_blockchain_tpu.bench_lib import bench_chain, bench_tpu, repeat_best
 emit("platform", jax.default_backend())
-emit("sweep", bench_tpu(seconds=8.0, batch_pow2=28, n_miners=1,
-                        kernel="auto"))
+# Official sections are best-of-2 with the spread on the record
+# (BASELINE.md's tunnel warning: a single run can be inflated >10x).
+# Rep 1 is STREAMED before the later reps run: the parent keeps the last
+# emitted payload per section, so a rep-2 wedge/raise can only lose the
+# rep discipline, never the completed measurement.
+def sweep_once():
+    return bench_tpu(seconds=6.0, batch_pow2=28, n_miners=1, kernel="auto")
+try:
+    first = sweep_once()
+    emit("sweep", first)
+    emit("sweep", repeat_best(sweep_once, reps=2,
+                              key="hashes_per_sec_per_chip",
+                              prior=[first]))
+except Exception as e:
+    emit("sweep_error", f"{type(e).__name__}: {e}")
 # Second half of the metric: wall-clock to mine 1000 blocks at difficulty
 # 24 (real accelerator only -- the host-CPU fallback would take hours).
 # blocks_per_call=500 from the round-4 hardware sweep: 18.6-18.7 s vs
 # 19.3-19.5 s at 100/250 (fewer host syncs); 1000 was no faster and a
 # single dispatch gives the watchdog no mid-run evidence.
 if jax.default_backend() != "cpu":
+    def chain_once():
+        return bench_chain(n_blocks=1000, difficulty_bits=24,
+                           blocks_per_call=500)
     try:
-        emit("chain", bench_chain(n_blocks=1000, difficulty_bits=24,
-                                  blocks_per_call=500))
+        first = chain_once()
+        emit("chain", first)
+        emit("chain", repeat_best(chain_once, reps=2, key="wall_s",
+                                  minimize=True, prior=[first]))
     except Exception as e:
         emit("chain_error", f"{type(e).__name__}: {e}")
     # Config 4's exact production combination on hardware: shard_map +
@@ -337,6 +365,8 @@ def main() -> int:
 
     # Sweep: prefer a fresh on-device measurement; fall back to last-good
     # cache (honestly labeled); only then to the CPU number.
+    if "sweep_error" in dev:
+        detail["sweep_error"] = dev["sweep_error"]
     sweep = dev.get("sweep")
     if sweep is not None and dev.get("platform") != "cpu":
         _cache_store("sweep", sweep)
@@ -370,8 +400,10 @@ def main() -> int:
             cached_util = _cached("utilization")
             if cached_util:
                 detail["utilization"] = cached_util
-            elif util_err:
-                detail["utilization"] = {"error": util_err}
+            else:
+                # A clean-exit child with no output would otherwise be
+                # indistinguishable from "not attempted" (ADVICE round 4).
+                detail["utilization"] = {"error": util_err or "no output"}
 
     chain = dev.get("chain")
     if chain is not None:
@@ -383,7 +415,8 @@ def main() -> int:
     if chain is not None and "wall_s" in chain:
         cpu_extrapolated_s = 1000 * (1 << 24) / cpu["hashes_per_sec"]
         detail["chain_1000_diff24"] = {
-            k: chain[k] for k in ("wall_s", "tip_hash") if k in chain}
+            k: chain[k] for k in ("wall_s", "tip_hash", "reps",
+                                  "spread_pct", "all_wall_s") if k in chain}
         detail["chain_1000_diff24"]["vs_cpu_np8_extrapolated"] = round(
             cpu_extrapolated_s / chain["wall_s"], 1)
         if chain.get("cached"):
@@ -392,13 +425,14 @@ def main() -> int:
 
     if source in ("fresh", "cache"):
         value = sweep["hashes_per_sec_per_chip"]
-        vs = sweep["hashes_per_sec"] / cpu["hashes_per_sec"]
+        vs = sweep["hashes_per_sec"] / CANONICAL_CPU_NP8_HS
         detail["tpu"] = _round_floats(sweep)
-        # vs_baseline divides by the SAME-RUN CPU sample (honest, but the
-        # denominator load-varies 0.8-1.8 MH/s across rounds); this pins
-        # the canonical round-1 8-rank rate for cross-round comparison.
-        detail["vs_cpu_canonical_1p78_mhs"] = round(
-            sweep["hashes_per_sec"] / 1.78e6, 1)
+        if source == "fresh":
+            # Only meaningful when numerator and denominator come from
+            # THIS run; a cached sweep over a fresh CPU sample would be
+            # exactly the cross-run load-drift the canonical ratio fixes.
+            detail["vs_cpu_same_run"] = round(
+                sweep["hashes_per_sec"] / cpu["hashes_per_sec"], 1)
     else:
         value = cpu["hashes_per_sec_per_rank"]
         vs = 1.0 / 8.0

@@ -13,6 +13,7 @@ Usage: python experiments/multiprocess_world.py [n_processes=8]
 from __future__ import annotations
 
 import json
+import os
 import pathlib
 import socket
 import subprocess
@@ -44,24 +45,43 @@ def main(n_processes: int = 8) -> int:
             "--backend", "tpu", "--kernel", "jnp", "--batch-pow2", "10",
             "--coordinator", f"127.0.0.1:{port}",
             "--num-processes", str(n_processes)]
-    env = {"PATH": "/usr/bin:/bin", "PYTHONPATH": str(REPO),
+    # Inherit the ambient environment (LD_LIBRARY_PATH, venv vars, ...)
+    # and override only what the ranks must see differently; a minimal
+    # hand-built env broke on machines whose interpreter needs more.
+    env = {**os.environ, "PYTHONPATH": str(REPO),
            "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
            "HOME": tmp}
+    env.pop("JAX_PLATFORMS", None)   # the wrapper forces cpu post-import
     t0 = time.time()
     procs = []
-    for i in range(n_processes):
-        argv = base + ["--process-id", str(i)]
-        if i == 0:
-            argv += ["--out", out_file]
-        procs.append(subprocess.Popen(
-            [sys.executable, "-c", _WRAPPER.format(argv=argv)],
-            env=env, cwd=str(REPO), stdout=subprocess.PIPE,
-            stderr=subprocess.PIPE, text=True))
-    for p in procs:
-        _, err = p.communicate(timeout=350)
-        if p.returncode != 0:
-            print(json.dumps({"error": err[-1500:]}))
-            return 1
+    try:
+        for i in range(n_processes):
+            argv = base + ["--process-id", str(i)]
+            if i == 0:
+                argv += ["--out", out_file]
+            procs.append(subprocess.Popen(
+                [sys.executable, "-c", _WRAPPER.format(argv=argv)],
+                env=env, cwd=str(REPO), stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE, text=True))
+        for p in procs:
+            try:
+                _, err = p.communicate(timeout=350)
+            except subprocess.TimeoutExpired:
+                # Same one-line JSON error contract as the rc!=0 path;
+                # the finally below reaps every surviving rank.
+                print(json.dumps({"error": "rank timed out after 350s"}))
+                return 1
+            if p.returncode != 0:
+                print(json.dumps({"error": err[-1500:]}))
+                return 1
+    finally:
+        # A timeout (or any failure) must not leak the surviving ranks —
+        # a live rank holds the distributed world open and would wedge
+        # the next launch's coordinator bind.
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
     wall = round(time.time() - t0, 1)
 
     from mpi_blockchain_tpu.config import MinerConfig

@@ -50,8 +50,7 @@ def make_multiround_search_fn(batch_size: int, difficulty_bits: int,
     sweep, effective = select_kernel(kernel, batch_size, difficulty_bits,
                                      shard=True)
     run = make_round_search(sweep, batch_size, batch_size * n_miners)
-    return maybe_shard_over_miners(run, n_miners, mesh,
-                                   n_in=4, n_out=3), effective
+    return maybe_shard_over_miners(run, n_miners, mesh, n_out=3), effective
 
 
 @register("tpu")

@@ -105,7 +105,7 @@ def make_fused_miner(k_blocks: int, batch_pow2: int, difficulty_bits: int,
         return nonces, tip
 
     from ..parallel.mesh import maybe_shard_over_miners
-    return maybe_shard_over_miners(mine_k, n_miners, mesh, n_in=3, n_out=2)
+    return maybe_shard_over_miners(mine_k, n_miners, mesh, n_out=2)
 
 
 class FusedMiner:

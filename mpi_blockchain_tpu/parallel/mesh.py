@@ -65,14 +65,23 @@ def make_miner_mesh(n_miners: int) -> Mesh:
 
 
 def maybe_shard_over_miners(fn, n_miners: int, mesh: Mesh | None,
-                            n_in: int, n_out: int):
+                            n_out: int):
     """jit-wraps a device program, shard_map'd over ('miners',) when
     n_miners > 1 OR an explicit mesh is passed — 1-element-axis collectives
     compile the same program, which is how the production sharded path gets
     hardware-proven on a single chip (bench.py sharded_pallas section).
-    fn must accept a keyword-only/last arg axis_name (None = unsharded);
-    all n_in inputs and n_out outputs are replicated."""
+    fn must accept an `axis_name` parameter (None = unsharded); its other
+    parameters are the device inputs — in_specs arity is derived from the
+    signature so callers cannot hand-miscount it. All inputs and the n_out
+    outputs are replicated."""
     import functools
+    import inspect
+    params = [p.name for p in inspect.signature(fn).parameters.values()]
+    if "axis_name" not in params:
+        raise ConfigError(
+            f"shardable device fn {getattr(fn, '__name__', fn)!r} must "
+            f"take an axis_name parameter; has {params}")
+    n_in = len(params) - 1
     if n_miners > 1 or mesh is not None:
         if mesh is None:
             mesh = make_miner_mesh(n_miners)

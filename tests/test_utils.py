@@ -50,15 +50,30 @@ def test_world_info_single_process():
     assert info["global_devices"] == len(jax.devices())
 
 
-def test_experiment_scripts_parse():
+def test_experiment_scripts_import():
     """experiments/ scripts are run standalone on hardware, outside the CI
-    import graph — a stale rename (e.g. a deleted kernel knob) would
-    otherwise only surface mid-measurement on the chip."""
-    import ast
+    import graph — a stale rename (e.g. a reference to a deleted module
+    attribute) PARSES fine and would only surface mid-measurement on the
+    chip, so each script is actually IMPORTED here. One throwaway
+    subprocess contains import-time global state (roofline.py forces
+    jax_platforms=cpu at import) and the __main__ guards keep main() from
+    running."""
     import pathlib
+    import subprocess
+    import sys
 
-    scripts = sorted((pathlib.Path(__file__).parent.parent
-                      / "experiments").glob("*.py"))
+    repo = pathlib.Path(__file__).parent.parent
+    scripts = sorted((repo / "experiments").glob("*.py"))
     assert scripts
-    for f in scripts:
-        ast.parse(f.read_text(), filename=str(f))
+    code = (
+        "import importlib.util, sys\n"
+        "for path in sys.argv[1:]:\n"
+        "    spec = importlib.util.spec_from_file_location('_exp', path)\n"
+        "    mod = importlib.util.module_from_spec(spec)\n"
+        "    spec.loader.exec_module(mod)\n"
+        "    print('imported', path)\n")
+    r = subprocess.run([sys.executable, "-c", code, *map(str, scripts)],
+                       capture_output=True, text=True, timeout=300,
+                       cwd=str(repo))
+    assert r.returncode == 0, r.stderr[-1500:]
+    assert r.stdout.count("imported") == len(scripts)
