@@ -1,0 +1,139 @@
+"""v5e-8 launch-readiness harness — BASELINE config 4, one command.
+
+The exact 8-chip program (`shard_map(('miners',8)) × Pallas × psum/pmin`)
+has never compiled on real 8-chip hardware in this environment (one chip
+behind the axon tunnel; virtual 8-device CPU meshes everywhere else).
+Everything it composes IS proven — 1-device mesh + Mosaic on hardware
+(BENCH sharded_pallas), 8-device mesh + jnp in CI and the driver dryrun —
+so this script is the single command to run on the day a v5e-8 appears:
+
+    python experiments/v5e8_launch.py
+
+It preflights (device count, mesh build, AOT-compile of the 8-way fused
+Pallas miner), runs config 4 LITERALLY (1000 blocks @ difficulty 24,
+batch 2^20/chip, 8 miners), and asserts the PRE-REGISTERED tip: the
+lowest-qualifying-nonce rule makes the mined bytes independent of
+n_miners and batching (proven n_miners-invariant on virtual meshes and
+batch-invariant across 2^22..2^25 on hardware — BASELINE.md "Tip
+reproducibility"), so the 8-chip result is knowable today:
+
+    PINNED_TIP_1000_D24 = 000000cb3a6e7b2e520d7843bbea907d84a0ae2ecca7...
+
+Reported: wall-clock, blocks/s, effective MH/s/chip, and scaling
+efficiency against 8 x the measured single-chip plateau. The CI twin
+(tests/test_v5e8_launch.py) runs launch() itself on the virtual 8-device
+CPU mesh at small scale against its own pinned tip every round.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import pathlib
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+# The 1000-block diff-24 tip, pre-registered from single-chip hardware
+# runs (>=8 independent runs, BASELINE.md) — the 8-chip run MUST mine
+# byte-identical blocks or the launch is a failure regardless of speed.
+PINNED_TIP_1000_D24 = \
+    "000000cb3a6e7b2e520d7843bbea907d84a0ae2ecca7e882e689fad96d1cd3a5"
+
+# Measured single-chip sweep plateau (bench.py, best-of-reps): the
+# denominator for 8-chip scaling efficiency.
+SINGLE_CHIP_PLATEAU_MHS = 970.0
+
+
+def launch(n_miners: int = 8, preset_overrides: dict | None = None,
+           blocks_per_call: int = 500,
+           expected_tip: str | None = PINNED_TIP_1000_D24) -> dict:
+    """Preflight + run config 4 on an n_miners mesh; returns the report.
+
+    preset_overrides shrinks the run for the CI twin (difficulty,
+    n_blocks, kernel, batch); the production call uses the literal
+    tpu-mesh8 preset. Raises RuntimeError on any launch-blocking failure
+    (missing devices, compile failure, wrong tip, invalid chain).
+    """
+    import jax
+
+    from mpi_blockchain_tpu import core
+    from mpi_blockchain_tpu.config import PRESETS
+    from mpi_blockchain_tpu.models.fused import FusedMiner
+    from mpi_blockchain_tpu.parallel.mesh import make_miner_mesh
+
+    report: dict = {"event": "v5e8_launch"}
+
+    # ---- preflight ------------------------------------------------------
+    devices = jax.devices()
+    report["platform"] = devices[0].platform
+    report["devices_visible"] = len(devices)
+    if len(devices) < n_miners:
+        raise RuntimeError(
+            f"preflight: need {n_miners} devices, have {len(devices)} "
+            f"({devices[0].platform})")
+    mesh = make_miner_mesh(n_miners)
+    report["mesh"] = str(dict(mesh.shape))
+
+    cfg = dataclasses.replace(PRESETS["tpu-mesh8"], n_miners=n_miners,
+                              **(preset_overrides or {}))
+    report["config"] = dataclasses.asdict(cfg)
+    miner = FusedMiner(cfg, blocks_per_call=blocks_per_call, mesh=mesh,
+                       log_fn=lambda d: None)
+    t0 = time.perf_counter()
+    miner.warmup()
+    if cfg.n_blocks % blocks_per_call:
+        miner.warmup(cfg.n_blocks % blocks_per_call)
+    report["compile_s"] = round(time.perf_counter() - t0, 3)
+
+    # ---- the run (config 4, literally) ----------------------------------
+    t0 = time.perf_counter()
+    miner.mine_chain()
+    wall = time.perf_counter() - t0
+    if miner.node.height != cfg.n_blocks:
+        raise RuntimeError(f"mined {miner.node.height}/{cfg.n_blocks}")
+    # Full PoW + linkage re-validation through the C++ loader.
+    if not core.Node(cfg.difficulty_bits, 0).load(miner.node.save()):
+        raise RuntimeError("mined chain failed C++ revalidation")
+
+    tip = miner.node.tip_hash.hex()
+    expected_hashes = cfg.n_blocks * (1 << cfg.difficulty_bits)
+    report.update({
+        "n_blocks": cfg.n_blocks, "difficulty_bits": cfg.difficulty_bits,
+        "wall_s": round(wall, 3),
+        "blocks_per_sec": round(cfg.n_blocks / wall, 1),
+        "effective_mhs_total": round(expected_hashes / wall / 1e6, 1),
+        "effective_mhs_per_chip": round(
+            expected_hashes / wall / n_miners / 1e6, 1),
+        "scaling_efficiency_vs_plateau": round(
+            expected_hashes / wall / 1e6
+            / (n_miners * SINGLE_CHIP_PLATEAU_MHS), 3),
+        "tip_hash": tip,
+    })
+    if expected_tip is not None:
+        report["tip_matches_preregistered"] = tip == expected_tip
+        if tip != expected_tip:
+            err = RuntimeError(
+                f"LAUNCH FAILURE: tip {tip} != pre-registered "
+                f"{expected_tip} — the determinism contract is broken")
+            # Keep the measured wall/rates/config with the failure: the
+            # multi-second run's diagnostics are needed to debug it.
+            err.report = report
+            raise err
+    return report
+
+
+def main() -> int:
+    try:
+        report = launch()
+    except RuntimeError as e:
+        print(json.dumps({"event": "v5e8_launch", "ok": False,
+                          "error": str(e),
+                          **getattr(e, "report", {})}, sort_keys=True))
+        return 1
+    print(json.dumps({**report, "ok": True}, sort_keys=True))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,63 @@
+"""CI twin of the v5e-8 launch-readiness harness (BASELINE config 4).
+
+Runs experiments/v5e8_launch.py's launch() — the exact code path the
+one-command hardware check uses — on the suite's virtual 8-device CPU
+mesh at small scale, against its own pre-registered tip. Every property
+the launch day depends on is asserted here each round: preflight, the
+8-way sharded fused compile, the run, C++ revalidation, and the
+tip-equality gate (including that a wrong expectation actually FAILS).
+"""
+import pathlib
+import sys
+
+import pytest
+
+from conftest import needs_devices
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from experiments.v5e8_launch import PINNED_TIP_1000_D24, launch  # noqa: E402
+
+# Pre-registered twin tip: diff 10, 20 blocks, jnp kernel, batch 2^10,
+# 8 miners, blocks_per_call 7 (crosses call boundaries + a remainder
+# chunk). Verified n_miners-invariant against the per-block CPU oracle
+# when first pinned.
+TWIN_TIP = "003c9229c9df7253ed6850ee67d2321465fe30577b4e72c1ca0e1442512cd404"
+TWIN = dict(difficulty_bits=10, n_blocks=20, batch_pow2=10, kernel="jnp")
+
+
+@needs_devices(8)
+def test_launch_twin_mines_preregistered_tip():
+    report = launch(n_miners=8, preset_overrides=TWIN, blocks_per_call=7,
+                    expected_tip=TWIN_TIP)
+    assert report["tip_matches_preregistered"] is True
+    assert report["devices_visible"] >= 8
+    assert report["n_blocks"] == 20
+    assert report["wall_s"] > 0 and report["compile_s"] > 0
+
+
+@needs_devices(8)
+def test_launch_gate_fails_on_wrong_tip():
+    with pytest.raises(RuntimeError, match="LAUNCH FAILURE"):
+        launch(n_miners=8, preset_overrides=TWIN, blocks_per_call=7,
+               expected_tip="00" * 32)
+
+
+def test_launch_preflight_rejects_missing_devices():
+    import jax
+
+    have = len(jax.devices())
+    with pytest.raises(RuntimeError, match="preflight"):
+        launch(n_miners=have + 1, preset_overrides=TWIN,
+               expected_tip=None)
+
+
+def test_pinned_production_tip_is_the_hardware_tip():
+    """The pre-registered 1000 @ diff-24 tip must stay in lockstep with
+    the bench record (BENCH_CACHE holds the last hardware-measured
+    chain section)."""
+    import json
+
+    cache = json.loads((pathlib.Path(__file__).resolve().parent.parent
+                        / "BENCH_CACHE.json").read_text())
+    assert cache["chain"]["payload"]["tip_hash"] == PINNED_TIP_1000_D24
