@@ -1,0 +1,142 @@
+"""Attribute the chain-vs-sweep gap (BASELINE.md "key findings").
+
+The 1000-block diff-24 chain runs ~1.3 s over the raw-sweep bound
+(expected work 1000 x 2^24 nonces at the plateau rate). This experiment
+splits that residual into its parts, each measured directly on the chip:
+
+  1. plateau      — raw pipelined sweep rate (the bound's denominator);
+  2. chain        — the production fused run (validation + append on);
+  3. device_only  — the same dispatches with NO host validation/append:
+                    chain - device_only = host-side cost the pipelining
+                    must hide;
+  4. fixed/block  — diff-64 max_rounds=1 fused programs (every block
+                    costs exactly one full 2^24 round, no early-exit
+                    variance) at TWO sizes; the per-block SLOPE between
+                    them minus the raw round time is the per-block device
+                    bookkeeping (midstate compress, header build, loop
+                    plumbing). The slope cancels the one-per-dispatch
+                    blocking-transfer latency (~90 ms under the axon
+                    tunnel) that a single-size probe would smear across
+                    its blocks and misattribute.
+
+Each section is printed the moment it is measured (the bench.py lesson:
+a tunnel wedge must not discard completed measurements), and a combined
+line closes the run.
+
+Usage: python experiments/chain_gap.py [n_blocks=1000]
+"""
+from __future__ import annotations
+
+import json
+import pathlib
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+DIFF, BATCH_POW2, BPC = 24, 24, 500
+
+
+def emit(**kv) -> None:
+    print(json.dumps(kv, sort_keys=True), flush=True)
+
+
+def main(n_blocks: int = 1000) -> int:
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mpi_blockchain_tpu import core
+    from mpi_blockchain_tpu.bench_lib import bench_chain, bench_tpu
+    from mpi_blockchain_tpu.config import MinerConfig
+    from mpi_blockchain_tpu.models.fused import (FusedMiner,
+                                                 make_fused_miner,
+                                                 _words_be)
+    from mpi_blockchain_tpu.parallel.mesh import replicated_host_value
+
+    out: dict = {"event": "chain_gap", "n_blocks": n_blocks,
+                 "difficulty_bits": DIFF, "batch_pow2": BATCH_POW2}
+
+    # 1. Plateau rate and the expected-work bound.
+    sweep = bench_tpu(seconds=4.0, batch_pow2=28)
+    rate = sweep["hashes_per_sec_per_chip"]
+    bound_s = n_blocks * (1 << DIFF) / rate
+    out["plateau_mhs"] = round(rate / 1e6, 1)
+    out["expected_work_bound_s"] = round(bound_s, 2)
+    emit(section="plateau", **{k: out[k] for k in
+                               ("plateau_mhs", "expected_work_bound_s")})
+
+    # 2. The production chain run.
+    chain = bench_chain(n_blocks=n_blocks, difficulty_bits=DIFF,
+                        batch_pow2=BATCH_POW2, blocks_per_call=BPC)
+    out["chain_wall_s"] = chain["wall_s"]
+    out["gap_s"] = round(chain["wall_s"] - bound_s, 2)
+    emit(section="chain", chain_wall_s=out["chain_wall_s"],
+         gap_s=out["gap_s"])
+
+    # 3. Device-only: identical dispatches, no host validation/append.
+    cfg = MinerConfig(difficulty_bits=DIFF, n_blocks=n_blocks,
+                      batch_pow2=BATCH_POW2, backend="tpu")
+    fm = FusedMiner(cfg, blocks_per_call=BPC, log_fn=lambda d: None)
+    fm.warmup(min(n_blocks, BPC))
+    if n_blocks > BPC and n_blocks % BPC:
+        fm.warmup(n_blocks % BPC)
+    prev = jnp.asarray(_words_be(fm.node.tip_hash))
+    t0 = time.perf_counter()
+    h, remaining = 0, n_blocks
+    nonces = None
+    while remaining > 0:
+        k = min(remaining, BPC)
+        data = np.stack([_words_be(core.sha256d(cfg.payload(h + j + 1)))
+                         for j in range(k)])
+        nonces, prev = fm._fn(k)(prev, jnp.asarray(data), np.uint32(h))
+        h += k
+        remaining -= k
+    replicated_host_value(nonces)          # drain the device queue
+    device_only = time.perf_counter() - t0
+    out["device_only_wall_s"] = round(device_only, 3)
+    out["host_side_s"] = round(chain["wall_s"] - device_only, 3)
+    emit(section="device_only", device_only_wall_s=out["device_only_wall_s"],
+         host_side_s=out["host_side_s"])
+
+    # 4. Per-block fixed device cost, free of early-exit variance AND of
+    #    per-dispatch latency: diff 64 + max_rounds=1 => every block is
+    #    exactly one full round; the slope between two probe sizes
+    #    cancels the one blocking transfer each dispatch pays.
+    def probe_wall(k: int) -> float:
+        probe = make_fused_miner(k, BATCH_POW2, 64, kernel="pallas",
+                                 max_rounds=1)
+        data = np.stack([_words_be(core.sha256d(b"probe:%d" % j))
+                         for j in range(k)])
+        args = (prev, jnp.asarray(data), np.uint32(0))
+        replicated_host_value(probe(*args)[0])        # compile + warm
+        walls = []
+        for _ in range(2):                            # min-of-2: tunnel
+            t0 = time.perf_counter()                  # noise damping
+            replicated_host_value(probe(*args)[0])
+            walls.append(time.perf_counter() - t0)
+        return min(walls)
+
+    k_small, k_big = 50, 150
+    t_small = probe_wall(k_small)
+    emit(section="probe_small", k=k_small, wall_s=round(t_small, 3))
+    t_big = probe_wall(k_big)
+    emit(section="probe_big", k=k_big, wall_s=round(t_big, 3))
+    round_s = (1 << BATCH_POW2) / rate                # one raw round
+    fixed_ms = ((t_big - t_small) / (k_big - k_small) - round_s) * 1e3
+    out["probe_blocks"] = [k_small, k_big]
+    out["probe_wall_s"] = [round(t_small, 3), round(t_big, 3)]
+    out["raw_round_s"] = round(round_s, 4)
+    out["fixed_device_cost_ms_per_block"] = round(fixed_ms, 3)
+    out["fixed_device_cost_total_s"] = round(fixed_ms * n_blocks / 1e3, 2)
+
+    # Residual not explained by host side or fixed device cost: early-exit
+    # skip overhead + realized-luck deviation from expected work.
+    out["unattributed_s"] = round(
+        out["gap_s"] - out["host_side_s"]
+        - out["fixed_device_cost_total_s"], 2)
+    print(json.dumps(out, sort_keys=True), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(int(sys.argv[1]) if len(sys.argv) > 1 else 1000))
