@@ -104,3 +104,57 @@ def test_fused_warmup_aot_identical(oracle_chain):
 # sentinel nonce now routes through the unified exhaustion-recovery path.
 # tests/test_exhaustion.py covers both recovery outcomes (rollover and
 # kernel-bug forensics).
+
+
+def test_pipeline_dispatch_accounting_and_recovery_discard():
+    """The pipelined span dispatches each batch exactly once in height
+    order; after a mid-span validation failure, the stale in-flight
+    batches are discarded and re-dispatched from the recovered tip."""
+    from mpi_blockchain_tpu.backend import get_backend
+    from test_exhaustion import ExhaustFirstSpace
+
+    # Prefix whose height-1 base winner lies beyond a 16-nonce capped
+    # sweep (so the device "fails" height 1 and recovery engages).
+    for i in range(32):
+        cfg = MinerConfig(difficulty_bits=DIFF, n_blocks=4, backend="tpu",
+                          kernel="jnp", batch_pow2=4,
+                          data_prefix=f"pipe{i}")
+        cand = core.Node(DIFF, 0).make_candidate(cfg.payload(1))
+        n, _ = core.cpu_search(cand, 0, 16, DIFF)
+        if n is None:
+            break
+    else:
+        pytest.fail("staging broken")
+    # Recovery is only consulted at the failing height (1), where the
+    # shared staged-exhaustion stub reports the base space empty.
+    fm = FusedMiner(cfg, blocks_per_call=1, log_fn=lambda d: None,
+                    recovery_backend=ExhaustFirstSpace(get_backend("cpu"),
+                                                       cfg))
+    capped = make_fused_miner(1, cfg.batch_pow2, DIFF, kernel="jnp",
+                              max_rounds=1)
+    real = make_fused_miner(1, cfg.batch_pow2, DIFF, kernel="jnp")
+    dispatch_heights = []
+
+    def spy(prev, data, h):
+        dispatch_heights.append(int(h))
+        # Height 0's dispatch (mining height 1) is capped so validation
+        # fails; later heights run the real full-space program.
+        fn = capped if int(h) == 0 else real
+        return fn(prev, data, h)
+
+    fm._fns[1] = spy
+    fm.mine_chain()
+    assert fm.node.height == 4
+    # The first span fills the in-flight window in height order, the
+    # failing height-0 batch is dispatched exactly once, and the stale
+    # in-flight batches are discarded and re-dispatched after recovery
+    # (invariants independent of the tuned window size).
+    depth = min(4, FusedMiner.PIPELINE_DEPTH)
+    assert dispatch_heights[:depth] == list(range(depth))
+    assert dispatch_heights.count(0) == 1
+    assert dispatch_heights[-3:] == [1, 2, 3]
+    # Recovered chain revalidates and height 1 carries the rollover
+    # payload.
+    assert core.Node(DIFF, 0).load(fm.node.save())
+    f = core.HeaderFields.unpack(fm.node.block_header(1))
+    assert f.data_hash == core.sha256d(cfg.payload(1, extra_nonce=1))
