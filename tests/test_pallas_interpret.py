@@ -205,3 +205,44 @@ def test_batch_validation_offline():
         sp.pallas_sweep_core(np.zeros(8, np.uint32), np.zeros(16, np.uint32),
                              np.uint32(0), batch_size=sp.TILE + 1,
                              difficulty_bits=8, interpret=True)
+
+
+@needs_devices(8)
+@pytest.mark.parametrize("q_tiles,exp_rounds", [(5, 1), (20, 2)])
+def test_multiround_searcher_with_interpret_pallas_on_8_mesh(
+        monkeypatch, q_tiles, exp_rounds):
+    """lax.while_loop over rounds x pallas_call(interpret) x psum/pmin on
+    an 8-device mesh — the launch-day per-block program SHAPE with only
+    Mosaic and the real tile math substituted (each proven elsewhere:
+    Mosaic+shard_map on hardware, tile math vs the C++ oracle). Closes
+    the one composition the CI bracket was missing: the device-resident
+    round loop around a pallas sweep under shard_map. q_tiles=20 forces
+    a second round, exercising the loop's carry through the collectives.
+    """
+    import functools
+
+    from jax.sharding import PartitionSpec as P
+
+    from mpi_blockchain_tpu.parallel.mesh import (make_miner_mesh,
+                                                  make_round_search)
+
+    monkeypatch.setattr(sp, "_tile_result", _mock_tile)
+    n_miners, n_tiles = 8, 2
+    batch = n_tiles * sp.TILE
+    round_size = batch * n_miners                 # 16 tiles per round
+    q = q_tiles * sp.TILE
+    sweep = functools.partial(sp.pallas_sweep_core, batch_size=batch,
+                              difficulty_bits=8, interpret=True)
+    run = make_round_search(sweep, batch, round_size)
+    fn = jax.jit(jax.shard_map(
+        functools.partial(run, axis_name="miners"),
+        mesh=make_miner_mesh(n_miners), in_specs=(P(),) * 4,
+        out_specs=(P(),) * 3, check_vma=False))   # interpret-mode-only
+    tail = np.zeros(16, np.uint32)
+    tail[0] = q
+    rounds, count, mn = (int(v) for v in fn(
+        np.zeros(8, np.uint32), tail, np.uint32(1), np.uint32(4)))
+    # Expected: first round whose contiguous range holds a multiple of q.
+    exp_c, exp_m = _expected(1 + (exp_rounds - 1) * round_size,
+                             round_size, q)
+    assert (rounds, count, mn) == (exp_rounds, exp_c, exp_m)

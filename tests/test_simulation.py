@@ -214,3 +214,39 @@ def test_suffix_sync_transfer_is_o_suffix():
     assert heal < height, (heal, height)
     for n in net.nodes:
         assert n.stats.conserved_height() == n.node.height
+
+
+def test_locator_heights_shape():
+    from mpi_blockchain_tpu.simulation import locator_heights
+
+    assert locator_heights(0) == [0]
+    assert locator_heights(1) == [1, 0]
+    hs = locator_heights(1000)
+    # Descending, starts at tip, ends at genesis, O(log) entries.
+    assert hs[0] == 1000 and hs[-1] == 0
+    assert hs == sorted(hs, reverse=True)
+    assert len(hs) < 30
+    # Dense near the tip (step 1 for the last 10)...
+    assert hs[:10] == list(range(1000, 990, -1))
+    # ...then exponentially widening gaps.
+    gaps = [a - b for a, b in zip(hs[9:-1], hs[10:])]
+    assert gaps == sorted(gaps), "gaps must be non-decreasing"
+
+
+def test_find_anchor_picks_highest_common():
+    cfg = MinerConfig(difficulty_bits=8, n_blocks=4, backend="cpu")
+    a, b = SimNode(0, cfg), SimNode(1, cfg)
+    # Shared prefix of 2 blocks, then a forks ahead alone.
+    for _ in range(2):
+        hdr = None
+        while hdr is None:
+            hdr = a.mine_step(1 << 12)
+        b.node.receive(hdr)
+    while a.node.height < 4:
+        a.mine_step(1 << 12)
+    from mpi_blockchain_tpu.simulation import locator_heights
+    locator = [(h, b.node.block_hash(h))
+               for h in locator_heights(b.node.height)]
+    assert a.find_anchor(locator) == 2     # the highest shared height
+    # A locator of unknown hashes anchors at genesis.
+    assert a.find_anchor([(5, b"\x11" * 32), (0, b"\x22" * 32)]) == 0
