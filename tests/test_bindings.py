@@ -108,3 +108,19 @@ def test_bad_binding_choice_rejected():
         [sys.executable, "-c", "import mpi_blockchain_tpu.core"],
         env=env, capture_output=True, text=True, timeout=60)
     assert proc.returncode != 0 and "MBT_BINDING" in proc.stderr
+
+
+def test_ctypes_binding_passes_chain_suite():
+    """The fallback binding's FULL chain/consensus surface — including the
+    round-5 suffix-sync additions (adopt_suffix, find, headers_from) —
+    must stay at parity with pybind11 every round, not only when someone
+    runs the suite under MBT_BINDING=ctypes by hand: run the chain test
+    module in a subprocess pinned to ctypes."""
+    env = dict(os.environ, MBT_BINDING="ctypes", PYTHONPATH=str(REPO))
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest", "tests/test_chain.py", "-q",
+         "--no-header", "-p", "no:cacheprovider"],
+        env=env, cwd=str(REPO), capture_output=True, text=True,
+        timeout=300)
+    assert proc.returncode == 0, proc.stdout[-1500:] + proc.stderr[-500:]
+    assert " passed" in proc.stdout   # rc 0 already proves zero failures
