@@ -215,6 +215,10 @@ def cmd_sim(args) -> int:
         "heights": [n.node.height for n in net.nodes],
         "tips": sorted(tips),
         "stats": [dataclasses.asdict(n.stats) for n in net.nodes],
+        # Exact accounting check: height == mined + accepted + adopted
+        # - reorged_away on every node (the suffix-sync stats contract).
+        "stats_conserved": all(n.stats.conserved_height() == n.node.height
+                               for n in net.nodes),
     }
     print(json.dumps(out, sort_keys=True))
     return 0 if net.converged() else 1

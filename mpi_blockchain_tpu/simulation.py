@@ -144,7 +144,18 @@ class SimNode:
         if r == core.RecvResult.APPENDED:
             self.stats.blocks_accepted_from_peers += 1
         elif r == core.RecvResult.STALE_OR_FORK:
-            self._sync_from(peer)
+            # Height gate on the peer's LIVE height (one O(1) query — the
+            # reference's height-allreduce shape): a peer whose chain is
+            # not longer than ours cannot win adoption, so syncing on its
+            # stale announcement could only return IGNORED_SHORTER. Old
+            # losing-branch announcements flushed at a partition heal
+            # would otherwise each trigger a redundant O(suffix) fetch.
+            # The ANNOUNCED height must not be the gate: under delivery
+            # delay the announcement is stale while the peer's chain has
+            # grown, and gating on it can suppress sync forever when the
+            # delay exceeds the peer's lead (equal-rate fork livelock).
+            if peer.node.height > self.node.height:
+                self._sync_from(peer)
 
     def _sync_from(self, peer: "SimNode") -> None:
         """O(suffix) longest-chain sync: send a block locator, fetch only

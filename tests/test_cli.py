@@ -66,6 +66,7 @@ def test_cli_sim_subcommand(capsys):
     assert out["converged"] is True
     assert len(set(out["tips"])) == 1
     assert all(h >= 4 for h in out["heights"])
+    assert out["stats_conserved"] is True
 
 
 def test_cli_info_subcommand(capsys):

@@ -250,3 +250,29 @@ def test_find_anchor_picks_highest_common():
     assert a.find_anchor(locator) == 2     # the highest shared height
     # A locator of unknown hashes anchors at genesis.
     assert a.find_anchor([(5, b"\x11" * 32), (0, b"\x22" * 32)]) == 0
+
+
+def test_stale_announcement_still_syncs_when_peer_is_ahead():
+    """The sync gate must use the peer's LIVE height, not the announced
+    block's: under delivery delay an announcement is stale while the
+    peer's chain has grown, and gating on the stale height can suppress
+    sync forever (equal-rate fork livelock). A height-1 announcement from
+    a peer whose live chain is longer must still trigger adoption."""
+    cfg = MinerConfig(difficulty_bits=8, n_blocks=6, backend="cpu")
+    a, b = SimNode(0, cfg), SimNode(1, cfg)
+    while a.node.height < 3:
+        a.mine_step(1 << 12)
+    while b.node.height < 2:
+        b.mine_step(1 << 12)
+    b.receive(a.node.block_header(1), a)   # stale: height 1 <= b's 2
+    assert b.node.height == 3 and b.node.tip_hash == a.node.tip_hash
+    # And the gate really does skip peers that are NOT longer: an unknown
+    # block from a 2-high fork triggers STALE_OR_FORK on a (height 3)
+    # but no fetch — the peer cannot win adoption.
+    c = SimNode(2, cfg)
+    while c.node.height < 2:
+        c.mine_step(1 << 12)
+    before = a.stats.headers_fetched
+    a.receive(c.node.block_header(2), c)
+    assert a.stats.headers_fetched == before
+    assert a.node.height == 3
