@@ -72,6 +72,13 @@ def launch(n_miners: int = 8, preset_overrides: dict | None = None,
         raise RuntimeError(
             f"preflight: need {n_miners} devices, have {len(devices)} "
             f"({devices[0].platform})")
+    if not preset_overrides and devices[0].platform == "cpu":
+        # The literal config 4 (1000 @ diff 24) on a virtual CPU mesh
+        # would grind for hours on the jnp fallback — only the CI twin
+        # (which shrinks the run via preset_overrides) belongs there.
+        raise RuntimeError(
+            "preflight: production config 4 expects real TPU devices; "
+            "found the cpu platform")
     mesh = make_miner_mesh(n_miners)
     report["mesh"] = str(dict(mesh.shape))
 

@@ -61,3 +61,20 @@ def test_pinned_production_tip_is_the_hardware_tip():
     cache = json.loads((pathlib.Path(__file__).resolve().parent.parent
                         / "BENCH_CACHE.json").read_text())
     assert cache["chain"]["payload"]["tip_hash"] == PINNED_TIP_1000_D24
+
+
+@needs_devices(8)
+def test_launch_preflight_rejects_cpu_platform_for_production_run():
+    """The literal config 4 on a CPU host must fail preflight instead of
+    grinding for hours on the jnp fallback; only the shrunken CI twin
+    (preset_overrides) may run off-TPU. On real 8-chip TPU hardware this
+    guard intentionally does NOT fire — skip there, or the test itself
+    would start the production run."""
+    import jax
+
+    if jax.devices()[0].platform != "cpu":
+        pytest.skip("guard only applies off-TPU")
+    with pytest.raises(RuntimeError, match="cpu platform"):
+        launch(n_miners=8)
+    with pytest.raises(RuntimeError, match="cpu platform"):
+        launch(n_miners=8, preset_overrides={})   # empty dict != shrunken
