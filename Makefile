@@ -1,0 +1,29 @@
+# Repo-level gates. `make check` is the one-command PR gate: chainlint
+# static analysis first (fails fast, ~100 ms), then the tier-1 test
+# command from ROADMAP.md.
+PY ?= python3
+SHELL := /bin/bash   # tier1 uses pipefail/PIPESTATUS
+
+.PHONY: check lint tier1 core clean
+
+check: lint tier1
+
+# chainlint: binding contract, header layout, JAX purity, sanitizer matrix.
+lint:
+	$(PY) -m mpi_blockchain_tpu.analysis
+
+# Tier-1 verify, verbatim from ROADMAP.md.
+tier1:
+	set -o pipefail; rm -f /tmp/_t1.log; \
+	timeout -k 10 870 env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q \
+	    -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
+	    -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; \
+	rc=$${PIPESTATUS[0]}; \
+	echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c); \
+	exit $$rc
+
+core:
+	$(MAKE) -C mpi_blockchain_tpu/core
+
+clean:
+	$(MAKE) -C mpi_blockchain_tpu/core clean

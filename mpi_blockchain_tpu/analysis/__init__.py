@@ -1,0 +1,135 @@
+"""chainlint — cross-language static analysis for the four-backend miner.
+
+The repo's correctness story is that four backends (scalar C++ core,
+ctypes/pybind11 bindings, jnp, Pallas) mine byte-identical chains. The
+dynamic equivalence suite proves that at run time; this package catches the
+classic *drift* bugs at analysis time, before any run launches:
+
+* ``binding_contract`` — every ``extern "C"`` symbol in ``core/src/capi.cpp``
+  cross-checked against the ctypes ``argtypes``/``restype`` declarations and
+  the pybind11 surface (BIND0xx rules).
+* ``header_layout`` — the frozen 80-byte header byte layout, cross-checked
+  between the C++ struct/serializer, the Python ``HeaderFields`` veneer, the
+  jnp kernel's nonce word index, and the golden-byte tests (HDR0xx rules).
+* ``jax_lint`` — AST lint of ``ops/``, ``models/``, ``parallel/`` for traced
+  branching, host callbacks, numpy leaks into jitted code, non-uint32 SHA
+  word arithmetic, and non-canonical mesh axis names (JAX0xx rules).
+* ``sanitizers`` — the tsan/asan/ubsan Makefile matrix plus the
+  cppcheck/clang-tidy ``analyze`` target, surfaced as SAN0xx rules (tools
+  gracefully skip when not installed).
+
+CLI: ``python -m mpi_blockchain_tpu.analysis`` — exits non-zero on any
+finding. Inline suppression: a ``chainlint: disable=RULE`` comment on the
+flagged line (see docs/static_analysis.md).
+
+This module imports only the standard library (no jax, no ctypes load, no
+C++ build), so the CLI is safe to run in any environment, including ones
+where the accelerator stack is absent.
+"""
+from __future__ import annotations
+
+import dataclasses
+import pathlib
+import re
+from typing import Callable, Iterable
+
+REPO_PACKAGE = "mpi_blockchain_tpu"
+
+_SUPPRESS_RE = re.compile(r"chainlint:\s*disable=([\w,\- ]+)")
+_SUPPRESS_FILE_RE = re.compile(r"chainlint:\s*disable-file=([\w,\- ]+)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One structured finding: tests assert on ``rule`` ids."""
+    file: str
+    line: int
+    rule: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.file}:{self.line}: {self.rule} {self.message}"
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def _suppressed_rules(match: re.Match | None) -> set[str]:
+    if match is None:
+        return set()
+    return {r.strip() for r in match.group(1).split(",") if r.strip()}
+
+
+def apply_suppressions(findings: Iterable[Finding],
+                       root: pathlib.Path) -> list[Finding]:
+    """Drops findings suppressed inline in their source file.
+
+    Line-level: the flagged line carries ``chainlint: disable=RULE[,RULE]``
+    (or ``disable=all``). File-level: any of the first 10 lines carries
+    ``chainlint: disable-file=RULE[,RULE]``.
+    """
+    kept: list[Finding] = []
+    cache: dict[str, list[str]] = {}
+    for f in findings:
+        path = root / f.file
+        lines = cache.get(f.file)
+        if lines is None:
+            try:
+                lines = path.read_text(errors="replace").splitlines()
+            except OSError:
+                lines = []
+            cache[f.file] = lines
+        file_rules: set[str] = set()
+        for head in lines[:10]:
+            file_rules |= _suppressed_rules(_SUPPRESS_FILE_RE.search(head))
+        line_rules: set[str] = set()
+        if 1 <= f.line <= len(lines):
+            line_rules = _suppressed_rules(
+                _SUPPRESS_RE.search(lines[f.line - 1]))
+        active = file_rules | line_rules
+        if f.rule in active or "all" in active:
+            continue
+        kept.append(f)
+    return kept
+
+
+def default_root() -> pathlib.Path:
+    """The repo root: parent of the mpi_blockchain_tpu package dir."""
+    return pathlib.Path(__file__).resolve().parent.parent.parent
+
+
+def pass_families() -> dict[str, Callable[..., list[Finding]]]:
+    """Registry of the pass families the CLI runs (import deferred so a
+    syntax error in one pass does not take down the others' rule docs)."""
+    from .binding_contract import run_binding_contract
+    from .header_layout import run_header_layout
+    from .jax_lint import run_jax_lint
+    from .sanitizers import run_sanitizers
+    return {
+        "binding": run_binding_contract,
+        "header": run_header_layout,
+        "jax": run_jax_lint,
+        "sanitizers": run_sanitizers,
+    }
+
+
+def run_all(root: pathlib.Path | None = None,
+            passes: Iterable[str] | None = None,
+            overrides: dict[str, pathlib.Path] | None = None,
+            notes: list[str] | None = None) -> list[Finding]:
+    """Runs the selected pass families and returns suppression-filtered
+    findings. ``overrides`` maps checker file keys (e.g. ``capi``,
+    ``chain_hpp``) to alternate paths — the drift-fixture test seam.
+    ``notes`` collects non-finding diagnostics (e.g. skipped tools)."""
+    root = root if root is not None else default_root()
+    registry = pass_families()
+    selected = list(passes) if passes is not None else list(registry)
+    unknown = [p for p in selected if p not in registry]
+    if unknown:
+        raise ValueError(f"unknown pass families {unknown}; "
+                         f"have {sorted(registry)}")
+    findings: list[Finding] = []
+    for name in selected:
+        findings.extend(registry[name](root, overrides=overrides or {},
+                                       notes=notes))
+    return apply_suppressions(findings, root)

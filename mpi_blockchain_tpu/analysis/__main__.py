@@ -1,0 +1,82 @@
+"""CLI: python -m mpi_blockchain_tpu.analysis
+
+Runs the chainlint pass families and exits non-zero on any finding —
+the PR gate `make check` runs this before the test suite. See
+docs/static_analysis.md for the rule catalogue.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+from . import default_root, pass_families, run_all
+
+OVERRIDE_KEYS = ("capi", "ctypes_binding", "pybind", "chain_hpp",
+                 "chain_cpp", "core_init", "sha_jnp", "header_test",
+                 "mesh_py", "core_makefile", "core_src")
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m mpi_blockchain_tpu.analysis",
+        description="chainlint: cross-language static analysis "
+                    "(binding contract, header layout, JAX purity, "
+                    "sanitizer matrix)")
+    parser.add_argument("--root", type=pathlib.Path, default=None,
+                        help="repo root (default: auto-detected)")
+    parser.add_argument("--passes", default=None,
+                        help="comma-separated subset of pass families "
+                             f"(default: all of {sorted(pass_families())})")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="emit findings as a JSON array")
+    parser.add_argument("--override", action="append", default=[],
+                        metavar="KEY=PATH",
+                        help="redirect one checked file (drift-fixture "
+                             f"test seam); keys: {', '.join(OVERRIDE_KEYS)}")
+    parser.add_argument("-q", "--quiet", action="store_true",
+                        help="suppress the summary/notes lines")
+    args = parser.parse_args(argv)
+
+    overrides: dict[str, pathlib.Path] = {}
+    for item in args.override:
+        key, _, value = item.partition("=")
+        if key not in OVERRIDE_KEYS or not value:
+            parser.error(f"bad --override {item!r}; keys: "
+                         f"{', '.join(OVERRIDE_KEYS)}")
+        overrides[key] = pathlib.Path(value)
+
+    passes = ([p.strip() for p in args.passes.split(",") if p.strip()]
+              if args.passes else None)
+    root = args.root if args.root is not None else default_root()
+    notes: list[str] = []
+    try:
+        findings = run_all(root=root, passes=passes, overrides=overrides,
+                           notes=notes)
+    except ValueError as e:
+        parser.error(str(e))
+    except OSError as e:
+        # A typo'd --override or a checked file missing from this install
+        # (e.g. a wheel without the C++ sources) is a clean usage error,
+        # not a traceback.
+        print(f"chainlint: cannot read a checked file: {e}",
+              file=sys.stderr)
+        return 2
+
+    if args.as_json:
+        print(json.dumps([f.to_dict() for f in findings], indent=2))
+    else:
+        for f in findings:
+            print(f.render())
+    if not args.quiet:
+        for note in notes:
+            print(f"note: {note}", file=sys.stderr)
+        print(f"chainlint: {len(findings)} finding(s) across "
+              f"{len(passes or pass_families())} pass families",
+              file=sys.stderr)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,244 @@
+"""BIND rules — the C ABI / ctypes / pybind11 contract checker.
+
+The ctypes binding re-declares every ``extern "C"`` prototype by hand; a
+drifted arity or width there corrupts arguments silently (a uint32 passed
+where C reads uint64 reads stack garbage — the exact class of bug that
+breaks cross-backend hash equivalence). The pybind11 module re-implements
+the same Python surface a second time. Both duplications are checked here:
+
+  BIND001  exported C symbol has no ctypes argtypes declaration
+  BIND002  argtypes arity differs from the C parameter count
+  BIND003  an argtype is incompatible with the C parameter type
+  BIND004  restype missing or incompatible with the C return type
+  BIND005  ctypes declares a symbol the C ABI does not export
+  BIND006  ctypes veneer exposes a name the pybind11 surface lacks
+  BIND007  pybind11 exposes a name the ctypes veneer lacks
+"""
+from __future__ import annotations
+
+import ast
+import pathlib
+import re
+
+from . import Finding
+from .cparse import parse_extern_c_funcs, strip_comments
+
+# C parameter type -> acceptable ctypes spellings. Byte buffers cross as
+# c_char_p (immutable bytes in) or POINTER(c_uint8) (out buffers) — both
+# are uint8_t* at the ABI level.
+ARG_OK = {
+    "uint8_t*": {"c_char_p", "POINTER(c_uint8)"},
+    "char*": {"c_char_p"},
+    "uint32_t*": {"POINTER(c_uint32)"},
+    "uint64_t*": {"POINTER(c_uint64)"},
+    "void*": {"c_void_p"},
+    "uint8_t": {"c_uint8"},
+    "uint16_t": {"c_uint16"},
+    "uint32_t": {"c_uint32"},
+    "uint64_t": {"c_uint64"},
+    "int64_t": {"c_int64"},
+    "int32_t": {"c_int32"},
+    "size_t": {"c_size_t"},
+    "int": {"c_int"},
+}
+RET_OK = {
+    "void*": {"c_void_p"},
+    "uint64_t": {"c_uint64"},
+    "uint32_t": {"c_uint32"},
+    "int64_t": {"c_int64"},
+    "int": {"c_int"},
+}
+
+# Surface names legitimately present on one binding only (documented in
+# docs/static_analysis.md; keep this list short and justified).
+SURFACE_ASYMMETRY_OK = {
+    "NOT_FOUND",   # ctypes-only sentinel; pybind11 returns None in-band
+}
+
+
+def _rel(path: pathlib.Path, root: pathlib.Path) -> str:
+    try:
+        return str(path.relative_to(root))
+    except ValueError:
+        return str(path)
+
+
+def _ctypes_expr_name(node: ast.expr, aliases: dict[str, str]) -> str:
+    """Canonical spelling of an argtypes/restype element expression."""
+    if isinstance(node, ast.Attribute):        # ctypes.c_char_p
+        return node.attr
+    if isinstance(node, ast.Name):             # _u8p / c_int
+        return aliases.get(node.id, node.id)
+    if isinstance(node, ast.Call):             # ctypes.POINTER(ctypes.c_X)
+        fn = _ctypes_expr_name(node.func, aliases)
+        args = ",".join(_ctypes_expr_name(a, aliases) for a in node.args)
+        return f"{fn}({args})"
+    if isinstance(node, ast.Constant) and node.value is None:
+        return "None"
+    return ast.dump(node)
+
+
+def parse_ctypes_decls(path: pathlib.Path):
+    """(argtypes, restypes, lines): per-symbol declarations from the
+    ``_lib.cc_x.argtypes = [...]`` / ``.restype = ...`` assignments."""
+    tree = ast.parse(path.read_text(), filename=str(path))
+    aliases: dict[str, str] = {}
+    argtypes: dict[str, list[str]] = {}
+    restypes: dict[str, str] = {}
+    lines: dict[str, int] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        tgt = node.targets[0]
+        if isinstance(tgt, ast.Name):          # _u8p = ctypes.POINTER(...)
+            aliases[tgt.id] = _ctypes_expr_name(node.value, aliases)
+            continue
+        if not (isinstance(tgt, ast.Attribute)
+                and isinstance(tgt.value, ast.Attribute)
+                and isinstance(tgt.value.value, ast.Name)
+                and tgt.value.value.id == "_lib"):
+            continue
+        sym = tgt.value.attr
+        lines.setdefault(sym, node.lineno)
+        if tgt.attr == "argtypes" and isinstance(node.value,
+                                                 (ast.List, ast.Tuple)):
+            argtypes[sym] = [_ctypes_expr_name(e, aliases)
+                             for e in node.value.elts]
+        elif tgt.attr == "restype":
+            restypes[sym] = _ctypes_expr_name(node.value, aliases)
+    return argtypes, restypes, lines
+
+
+def parse_pybind_surface(path: pathlib.Path):
+    """(module_names, class_members): names bound in the pybind11 module."""
+    text = strip_comments(path.read_text(errors="replace"))
+    module = set(re.findall(r'\bm\.def\(\s*"(\w+)"', text))
+    module |= set(re.findall(r'\bm\.attr\("(\w+)"\)', text))
+    module |= set(re.findall(r'py::class_<\w+>\(m,\s*"(\w+)"\)', text))
+    members = set(re.findall(r'(?<!m)\.def\(\s*"(\w+)"', text))
+    members |= set(re.findall(r'\.def_property_readonly\(\s*"(\w+)"', text))
+    return module, members
+
+
+def parse_ctypes_surface(path: pathlib.Path):
+    """(module_names, class_members): the public veneer surface of the
+    ctypes binding module — top-level functions/constants plus the methods,
+    properties, and __init__-assigned attributes of class Node."""
+    tree = ast.parse(path.read_text(), filename=str(path))
+    module: set[str] = set()
+    members: set[str] = set()
+    for node in tree.body:
+        if isinstance(node, ast.FunctionDef) and not node.name.startswith("_"):
+            module.add(node.name)
+        elif isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and not tgt.id.startswith("_"):
+                    module.add(tgt.id)
+        elif isinstance(node, ast.ClassDef) and not node.name.startswith("_"):
+            module.add(node.name)
+            for item in node.body:
+                if isinstance(item, ast.FunctionDef):
+                    if not item.name.startswith("_"):
+                        members.add(item.name)
+                    if item.name == "__init__":
+                        for sub in ast.walk(item):
+                            if (isinstance(sub, ast.Attribute)
+                                    and isinstance(sub.ctx, ast.Store)
+                                    and isinstance(sub.value, ast.Name)
+                                    and sub.value.id == "self"
+                                    and not sub.attr.startswith("_")):
+                                members.add(sub.attr)
+    return module, members
+
+
+def run_binding_contract(root: pathlib.Path, overrides=None,
+                         notes=None) -> list[Finding]:
+    overrides = overrides or {}
+    pkg = root / "mpi_blockchain_tpu"
+    capi = overrides.get("capi", pkg / "core" / "src" / "capi.cpp")
+    binding = overrides.get("ctypes_binding",
+                            pkg / "core" / "_ctypes_binding.py")
+    pybind = overrides.get("pybind",
+                           pkg / "core" / "src" / "pybind_module.cpp")
+
+    findings: list[Finding] = []
+    cfuncs = parse_extern_c_funcs(capi)
+    argtypes, restypes, decl_lines = parse_ctypes_decls(binding)
+    capi_rel, binding_rel = _rel(capi, root), _rel(binding, root)
+
+    for name, fn in sorted(cfuncs.items()):
+        if name not in argtypes:
+            findings.append(Finding(
+                capi_rel, fn.line, "BIND001",
+                f"exported symbol {name} has no ctypes argtypes "
+                f"declaration in {binding_rel}"))
+            continue
+        declared = argtypes[name]
+        line = decl_lines.get(name, 1)
+        if len(declared) != len(fn.params):
+            findings.append(Finding(
+                binding_rel, line, "BIND002",
+                f"{name}: argtypes arity {len(declared)} != C parameter "
+                f"count {len(fn.params)} "
+                f"({', '.join(p.ctype for p in fn.params)})"))
+        else:
+            for i, (p, d) in enumerate(zip(fn.params, declared)):
+                ok = ARG_OK.get(p.ctype, set())
+                if d not in ok:
+                    findings.append(Finding(
+                        binding_rel, line, "BIND003",
+                        f"{name}: argtypes[{i}] is {d}; C declares "
+                        f"'{p.name}: {p.ctype}' (expected one of "
+                        f"{sorted(ok) or ['<unmappable>']})"))
+        declared_ret = restypes.get(name)
+        if fn.ret == "void":
+            if declared_ret not in (None, "None"):
+                findings.append(Finding(
+                    binding_rel, line, "BIND004",
+                    f"{name}: restype {declared_ret} declared but C "
+                    f"returns void"))
+        else:
+            ok = RET_OK.get(fn.ret, set())
+            if declared_ret is None:
+                findings.append(Finding(
+                    binding_rel, line, "BIND004",
+                    f"{name}: no restype declared; C returns {fn.ret} "
+                    f"(ctypes would silently truncate through the c_int "
+                    f"default)"))
+            elif declared_ret not in ok:
+                findings.append(Finding(
+                    binding_rel, line, "BIND004",
+                    f"{name}: restype {declared_ret} incompatible with C "
+                    f"return {fn.ret} (expected one of {sorted(ok)})"))
+
+    for name in sorted(set(argtypes) - set(cfuncs)):
+        findings.append(Finding(
+            binding_rel, decl_lines.get(name, 1), "BIND005",
+            f"ctypes declares {name} but {capi_rel} exports no such "
+            f"symbol"))
+
+    # pybind11 <-> ctypes veneer surface parity.
+    pb_module, pb_members = parse_pybind_surface(pybind)
+    ct_module, ct_members = parse_ctypes_surface(binding)
+    pybind_rel = _rel(pybind, root)
+    for name in sorted((ct_module - pb_module) - SURFACE_ASYMMETRY_OK):
+        findings.append(Finding(
+            pybind_rel, 1, "BIND006",
+            f"ctypes veneer exposes module-level '{name}' but the pybind11 "
+            f"module does not bind it"))
+    for name in sorted((ct_members - pb_members) - SURFACE_ASYMMETRY_OK):
+        findings.append(Finding(
+            pybind_rel, 1, "BIND006",
+            f"ctypes Node exposes '{name}' but the pybind11 Node does not "
+            f"bind it"))
+    for name in sorted((pb_module - ct_module) - SURFACE_ASYMMETRY_OK):
+        findings.append(Finding(
+            binding_rel, 1, "BIND007",
+            f"pybind11 binds module-level '{name}' but the ctypes veneer "
+            f"does not expose it"))
+    for name in sorted((pb_members - ct_members) - SURFACE_ASYMMETRY_OK):
+        findings.append(Finding(
+            binding_rel, 1, "BIND007",
+            f"pybind11 Node binds '{name}' but the ctypes Node does not "
+            f"expose it"))
+    return findings

@@ -1,0 +1,256 @@
+"""HDR rules — the frozen 80-byte header byte-layout cross-check.
+
+Every backend depends on the exact same serialization (chain.hpp's FROZEN
+table); a silently reordered or resized field is the AsicBoost-class drift
+this pass exists to catch. The canonical layout is pinned HERE, and four
+independent encodings of it are checked against it:
+
+  HDR001  C++ BlockHeader struct field order/width differs from canonical
+  HDR002  header size constant (kHeaderSize / HEADER_SIZE) is not 80
+  HDR003  chain.cpp serialize()/deserialize() offsets differ from canonical
+  HDR004  a Python-side layout anchor (HeaderFields codec, set_nonce slice,
+          jnp kernel nonce word index, golden-byte test offsets) disagrees
+
+The nonce MUST live in SHA-256 chunk 2 at word 3 (byte offset 76 = 64 +
+3*4): the midstate optimization in every backend assumes it.
+"""
+from __future__ import annotations
+
+import pathlib
+import re
+
+from . import Finding
+from .cparse import extract_function_body, parse_struct_fields
+
+CANONICAL = (("version", 4), ("prev_hash", 32), ("data_hash", 32),
+             ("timestamp", 4), ("bits", 4), ("nonce", 4))
+HEADER_SIZE = 80
+NONCE_OFFSET = 76           # == 64 (chunk 1) + 3 (word index) * 4
+
+
+def canonical_offsets() -> dict[str, tuple[int, int]]:
+    out, off = {}, 0
+    for name, width in CANONICAL:
+        out[name] = (off, width)
+        off += width
+    return out
+
+
+def _rel(path: pathlib.Path, root: pathlib.Path) -> str:
+    try:
+        return str(path.relative_to(root))
+    except ValueError:
+        return str(path)
+
+
+def _check_struct(findings, hpp: pathlib.Path, rel: str):
+    fields = parse_struct_fields(hpp, "BlockHeader")
+    if not fields:
+        findings.append(Finding(rel, 1, "HDR001",
+                                "struct BlockHeader not found / no parsable "
+                                "data members"))
+        return
+    got = [(f.name, f.width) for f in fields]
+    if got != list(CANONICAL):
+        for i, (g, c) in enumerate(zip(got, CANONICAL)):
+            if g != c:
+                findings.append(Finding(
+                    rel, fields[i].line, "HDR001",
+                    f"BlockHeader field {i} is {g[0]}[{g[1]}B]; the frozen "
+                    f"layout requires {c[0]}[{c[1]}B] here (full layout: "
+                    f"{[n for n, _ in CANONICAL]})"))
+                break
+        else:
+            findings.append(Finding(
+                rel, fields[0].line, "HDR001",
+                f"BlockHeader has {len(got)} data members; the frozen "
+                f"layout has {len(CANONICAL)}"))
+    total = sum(w for _, w in got)
+    if total != HEADER_SIZE:
+        findings.append(Finding(
+            rel, fields[0].line, "HDR002",
+            f"BlockHeader fields total {total} bytes; the frozen header "
+            f"is {HEADER_SIZE}"))
+    text = hpp.read_text(errors="replace")
+    m = re.search(r"kHeaderSize\s*=\s*(\d+)", text)
+    if m and int(m.group(1)) != HEADER_SIZE:
+        findings.append(Finding(
+            rel, text[:m.start()].count("\n") + 1, "HDR002",
+            f"kHeaderSize = {m.group(1)}; the frozen header is "
+            f"{HEADER_SIZE}"))
+
+
+def _serializer_offsets(body: str, buf: str) -> dict[str, int]:
+    """Field -> byte offset from store_le32/load_le32/memcpy calls against
+    buffer variable ``buf`` in a serialize/deserialize body."""
+    offsets: dict[str, int] = {}
+    for m in re.finditer(
+            rf"store_le32\(\s*{buf}\s*(?:\+\s*(\d+))?\s*,\s*(\w+)\s*\)",
+            body):
+        offsets[m.group(2)] = int(m.group(1) or 0)
+    for m in re.finditer(
+            rf"(\w+)\s*=\s*load_le32\(\s*{buf}\s*(?:\+\s*(\d+))?\s*\)",
+            body):
+        offsets[m.group(1).split(".")[-1]] = int(m.group(2) or 0)
+    for m in re.finditer(
+            rf"memcpy\(\s*{buf}\s*(?:\+\s*(\d+))?\s*,\s*[\w.]*?(\w+)\s*,",
+            body):
+        offsets[m.group(2)] = int(m.group(1) or 0)
+    for m in re.finditer(
+            rf"memcpy\(\s*[\w.]*?(\w+)\s*,\s*{buf}\s*(?:\+\s*(\d+))?\s*,",
+            body):
+        offsets[m.group(1)] = int(m.group(2) or 0)
+    return offsets
+
+
+def _check_serializer(findings, cpp: pathlib.Path, rel: str):
+    canon = canonical_offsets()
+    for fn_re, buf, label in (
+            (r"void\s+BlockHeader::serialize\s*\(", "out", "serialize"),
+            (r"BlockHeader\s+BlockHeader::deserialize\s*\(", "in",
+             "deserialize")):
+        body = extract_function_body(cpp, fn_re)
+        if not body:
+            findings.append(Finding(rel, 1, "HDR003",
+                                    f"BlockHeader::{label} not found"))
+            continue
+        got = _serializer_offsets(body, buf)
+        normalized = {k.removeprefix("h."): v for k, v in got.items()}
+        for field, (off, _w) in canon.items():
+            if field not in normalized:
+                findings.append(Finding(
+                    rel, 1, "HDR003",
+                    f"BlockHeader::{label} never touches field "
+                    f"'{field}'"))
+            elif normalized[field] != off:
+                findings.append(Finding(
+                    rel, 1, "HDR003",
+                    f"BlockHeader::{label} places '{field}' at offset "
+                    f"{normalized[field]}; the frozen layout puts it at "
+                    f"{off}"))
+
+
+def _check_python_codec(findings, core_init: pathlib.Path, rel: str):
+    canon = canonical_offsets()
+    text = core_init.read_text(errors="replace")
+    lines = text.splitlines()
+
+    def lineno(pat: str) -> int:
+        for i, ln in enumerate(lines, 1):
+            if re.search(pat, ln):
+                return i
+        return 1
+
+    # Every anchor FAILS CLOSED: a regex that no longer matches is itself
+    # a finding, so a refactor cannot silently disable this leg of the
+    # cross-check.
+    def anchor(pattern: str, what: str):
+        m = re.search(pattern, text)
+        if m is None:
+            findings.append(Finding(
+                rel, 1, "HDR004",
+                f"could not locate {what} in {rel} — the Python-codec "
+                f"layout anchor is gone; update analysis/header_layout.py "
+                f"alongside the refactor"))
+        return m
+
+    m = anchor(r'unpack_from\("<I",\s*header80,\s*(\d+)\)',
+               "the HeaderFields version unpack_from('<I', ...)")
+    if m and int(m.group(1)) != canon["version"][0]:
+        findings.append(Finding(
+            rel, lineno(r'unpack_from\("<I"'), "HDR004",
+            f"HeaderFields.unpack reads version at {m.group(1)}; the "
+            f"frozen layout puts it at {canon['version'][0]}"))
+    m = anchor(r'unpack_from\("<III",\s*header80,\s*(\d+)\)',
+               "the HeaderFields timestamp/bits/nonce unpack_from('<III')")
+    if m and int(m.group(1)) != canon["timestamp"][0]:
+        findings.append(Finding(
+            rel, lineno(r'unpack_from\("<III"'), "HDR004",
+            f"HeaderFields.unpack reads timestamp/bits/nonce from "
+            f"{m.group(1)}; the frozen layout starts them at "
+            f"{canon['timestamp'][0]}"))
+    slices = [(int(a), int(b)) for a, b in
+              re.findall(r"header80\[(\d+):(\d+)\]", text)]
+    expected = [(canon["prev_hash"][0],
+                 canon["prev_hash"][0] + canon["prev_hash"][1]),
+                (canon["data_hash"][0],
+                 canon["data_hash"][0] + canon["data_hash"][1])]
+    if not slices:
+        findings.append(Finding(
+            rel, 1, "HDR004",
+            f"could not locate the HeaderFields hash-field slices "
+            f"(header80[a:b]) in {rel} — layout anchor gone"))
+    for sl in slices:
+        if sl not in expected:
+            findings.append(Finding(
+                rel, lineno(rf"header80\[{sl[0]}:{sl[1]}\]"), "HDR004",
+                f"HeaderFields slices header80[{sl[0]}:{sl[1]}]; frozen "
+                f"hash fields live at {expected}"))
+    m = anchor(r"header80\[:(\d+)\]", "the set_nonce prefix slice")
+    if m and int(m.group(1)) != NONCE_OFFSET:
+        findings.append(Finding(
+            rel, lineno(r"header80\[:(\d+)\]"), "HDR004",
+            f"set_nonce keeps header80[:{m.group(1)}]; the frozen nonce "
+            f"offset is {NONCE_OFFSET}"))
+    m = anchor(r"HEADER_SIZE\s*=\s*(\d+)", "the HEADER_SIZE constant")
+    if m and int(m.group(1)) != HEADER_SIZE:
+        findings.append(Finding(
+            rel, lineno(r"HEADER_SIZE\s*="), "HDR002",
+            f"Python HEADER_SIZE = {m.group(1)}; the frozen header is "
+            f"{HEADER_SIZE}"))
+
+
+def _check_jnp_kernel(findings, sha_jnp: pathlib.Path, rel: str):
+    text = sha_jnp.read_text(errors="replace")
+    m = (re.search(r"NONCE_WORD_INDEX\s*=\s*(\d+)", text)
+         or re.search(r"i\s*!=\s*(\d+)\s+else\s+nonce_word", text))
+    if m is None:
+        findings.append(Finding(
+            rel, 1, "HDR004",
+            "could not locate the chunk-2 nonce word index "
+            "(NONCE_WORD_INDEX constant or the inline tail_w "
+            "substitution) in the jnp kernel"))
+        return
+    idx = int(m.group(1))
+    if 64 + idx * 4 != NONCE_OFFSET:
+        findings.append(Finding(
+            rel, text[:m.start()].count("\n") + 1, "HDR004",
+            f"jnp kernel substitutes the nonce at chunk-2 word {idx} "
+            f"(byte {64 + idx * 4}); the frozen nonce offset is "
+            f"{NONCE_OFFSET}"))
+
+
+def _check_golden_test(findings, test_path: pathlib.Path, rel: str):
+    canon = canonical_offsets()
+    valid = {(off, off + w) for off, w in canon.values()}
+    text = test_path.read_text(errors="replace")
+    for m in re.finditer(r"cand\[(\d+):(\d+)\]", text):
+        sl = (int(m.group(1)), int(m.group(2)))
+        if sl not in valid:
+            findings.append(Finding(
+                rel, text[:m.start()].count("\n") + 1, "HDR004",
+                f"golden-byte test slices cand[{sl[0]}:{sl[1]}], which is "
+                f"not a frozen field span {sorted(valid)}"))
+
+
+def run_header_layout(root: pathlib.Path, overrides=None,
+                      notes=None) -> list[Finding]:
+    overrides = overrides or {}
+    pkg = root / "mpi_blockchain_tpu"
+    hpp = overrides.get("chain_hpp", pkg / "core" / "src" / "chain.hpp")
+    cpp = overrides.get("chain_cpp", pkg / "core" / "src" / "chain.cpp")
+    core_init = overrides.get("core_init", pkg / "core" / "__init__.py")
+    sha_jnp = overrides.get("sha_jnp", pkg / "ops" / "sha256_jnp.py")
+    golden = overrides.get("header_test",
+                           root / "tests" / "test_header_layout.py")
+
+    findings: list[Finding] = []
+    _check_struct(findings, hpp, _rel(hpp, root))
+    _check_serializer(findings, cpp, _rel(cpp, root))
+    _check_python_codec(findings, core_init, _rel(core_init, root))
+    _check_jnp_kernel(findings, sha_jnp, _rel(sha_jnp, root))
+    if golden.exists():
+        _check_golden_test(findings, golden, _rel(golden, root))
+    elif notes is not None:
+        notes.append(f"header: golden-byte test {golden} absent; skipped")
+    return findings

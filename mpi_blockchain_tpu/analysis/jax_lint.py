@@ -1,0 +1,330 @@
+"""JAX rules — AST purity/dtype lint over ops/, models/, parallel/.
+
+The device kernels are only correct if they stay inside the jit tracing
+model: no Python branching on traced values, no host work inside a traced
+function, uint32 discipline on every SHA word, and mesh collectives only
+over the canonical axis names. All four are silent-wrong-answer bugs on a
+TPU, so they are linted statically:
+
+  JAX001  Python if/while branches on a traced parameter inside a traced
+          function (trace-time branch: compiles one side only)
+  JAX002  host callback / host-sync call inside a traced function
+  JAX003  numpy call (other than a dtype constructor) inside a traced
+          function — host computation baked in as a constant
+  JAX004  bare int literal in bitwise/shift SHA word arithmetic (dtype
+          promotion risk; wrap in np.uint32/jnp.uint32)
+  JAX005  mesh axis name not in the canonical set from parallel/mesh.py
+
+"Traced function" is detected structurally: decorated with jax.jit (bare
+or via functools.partial with static_argnames), wrapped by a jax.jit(...)
+call, or passed as the function argument of lax.scan / lax.while_loop /
+lax.fori_loop / lax.cond / shard_map. Nested helpers called from traced
+code without one of those markers are deliberately out of scope — the rule
+set prefers silence over false positives on host-side builder code.
+"""
+from __future__ import annotations
+
+import ast
+import pathlib
+from dataclasses import dataclass, field
+
+from . import Finding
+
+LINT_DIRS = ("ops", "models", "parallel")
+# JAX004 scope: the kernels where every BinOp operand IS a SHA word.
+# (models/fused.py does host-side config math like `1 << batch_pow2`, so
+# the literal-operand heuristic would false-positive there.)
+SHA_WORD_MODULES = ("ops/sha256_jnp.py", "ops/sha256_pallas.py")
+
+DTYPE_CONSTRUCTORS = {
+    "uint8", "uint16", "uint32", "uint64", "int8", "int16", "int32",
+    "int64", "float16", "float32", "float64", "bool_", "dtype",
+}
+HOST_CALLBACK_NAMES = {"pure_callback", "io_callback", "host_callback"}
+HOST_SYNC_METHODS = {"item", "tolist", "block_until_ready", "copy_to_host",
+                     "__array__"}
+# Calls that trace a function argument -> which positional slots hold it.
+TRACING_HOFS = {"scan": (0,), "while_loop": (0, 1), "fori_loop": (2,),
+                "cond": (1, 2), "shard_map": (0,), "pallas_call": (0,)}
+# Collectives/queries whose axis argument must be a canonical mesh axis
+# -> the positional slot that argument occupies.
+AXIS_CALLS = {"psum": 1, "pmin": 1, "pmax": 1, "pmean": 1, "all_gather": 1,
+              "ppermute": 1, "axis_index": 0, "axis_size": 0}
+
+
+def _call_name(node: ast.Call) -> str:
+    """Rightmost name of the called expression: jax.lax.psum -> 'psum'."""
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return ""
+
+
+def _dotted(node: ast.expr) -> str:
+    """Best-effort dotted path: jax.lax.psum -> 'jax.lax.psum'."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def _is_jit_expr(node: ast.expr) -> tuple[bool, set[str]]:
+    """(is a jax.jit marker, static_argnames it pins)."""
+    if isinstance(node, (ast.Attribute, ast.Name)):
+        d = _dotted(node)
+        return d in ("jax.jit", "jit"), set()
+    if isinstance(node, ast.Call):
+        d = _dotted(node.func)
+        if d in ("jax.jit", "jit"):
+            return True, _static_argnames(node)
+        if d in ("functools.partial", "partial") and node.args:
+            inner, static = _is_jit_expr(node.args[0])
+            return inner, static | _static_argnames(node)
+    return False, set()
+
+
+def _static_argnames(call: ast.Call) -> set[str]:
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                return {v.value}
+            if isinstance(v, (ast.Tuple, ast.List)):
+                return {e.value for e in v.elts
+                        if isinstance(e, ast.Constant)}
+    return set()
+
+
+@dataclass
+class TracedFn:
+    node: ast.FunctionDef
+    static: set[str] = field(default_factory=set)
+
+    @property
+    def traced_params(self) -> set[str]:
+        args = self.node.args
+        names = [a.arg for a in args.args + args.posonlyargs
+                 + args.kwonlyargs]
+        return {n for n in names if n not in self.static
+                and n != "axis_name"}
+
+
+def _collect_traced_functions(tree: ast.Module) -> list[TracedFn]:
+    by_name: dict[str, ast.FunctionDef] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef):
+            by_name.setdefault(node.name, node)
+
+    traced: dict[int, TracedFn] = {}
+
+    def mark(fn: ast.FunctionDef, static: set[str]):
+        traced.setdefault(id(fn), TracedFn(fn, static))
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef):
+            for dec in node.decorator_list:
+                is_jit, static = _is_jit_expr(dec)
+                if is_jit:
+                    mark(node, static)
+        elif isinstance(node, ast.Call):
+            name = _call_name(node)
+            dotted = _dotted(node.func)
+            if dotted in ("jax.jit", "jit") and node.args:
+                target = node.args[0]
+                static = _static_argnames(node)
+                if isinstance(target, ast.Name) and target.id in by_name:
+                    mark(by_name[target.id], static)
+            elif name in TRACING_HOFS:
+                for slot in TRACING_HOFS[name]:
+                    if slot >= len(node.args):
+                        continue
+                    target = node.args[slot]
+                    if isinstance(target, ast.Name) and target.id in by_name:
+                        mark(by_name[target.id], set())
+                    elif (isinstance(target, ast.Call)
+                          and _dotted(target.func) in ("functools.partial",
+                                                       "partial")
+                          and target.args
+                          and isinstance(target.args[0], ast.Name)
+                          and target.args[0].id in by_name):
+                        mark(by_name[target.args[0].id], set())
+    return list(traced.values())
+
+
+def _names_in(node: ast.expr) -> set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _lint_traced_fn(findings, rel: str, tf: TracedFn):
+    traced_params = tf.traced_params
+    for node in ast.walk(tf.node):
+        if isinstance(node, (ast.If, ast.While)):
+            hot = _names_in(node.test) & traced_params
+            if hot:
+                kind = "if" if isinstance(node, ast.If) else "while"
+                findings.append(Finding(
+                    rel, node.lineno, "JAX001",
+                    f"Python `{kind}` on traced value(s) "
+                    f"{sorted(hot)} inside traced function "
+                    f"'{tf.node.name}' — use lax.cond/lax.while_loop or "
+                    f"mark the argument static"))
+        elif isinstance(node, ast.Call):
+            name = _call_name(node)
+            dotted = _dotted(node.func)
+            if (name in HOST_CALLBACK_NAMES
+                    or dotted.startswith("jax.debug.")
+                    or dotted in ("debug.print", "debug.callback")):
+                findings.append(Finding(
+                    rel, node.lineno, "JAX002",
+                    f"host callback '{dotted or name}' inside traced "
+                    f"function '{tf.node.name}'"))
+            elif (name in HOST_SYNC_METHODS
+                    and isinstance(node.func, ast.Attribute)):
+                findings.append(Finding(
+                    rel, node.lineno, "JAX002",
+                    f"host-sync call '.{name}()' inside traced function "
+                    f"'{tf.node.name}'"))
+            elif (isinstance(node.func, ast.Attribute)
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id in ("np", "numpy")
+                    and node.func.attr not in DTYPE_CONSTRUCTORS):
+                findings.append(Finding(
+                    rel, node.lineno, "JAX003",
+                    f"numpy call 'np.{node.func.attr}' inside traced "
+                    f"function '{tf.node.name}' — host computation baked "
+                    f"in at trace time; use jnp or hoist it"))
+
+
+_BITWISE = (ast.BitAnd, ast.BitOr, ast.BitXor, ast.LShift, ast.RShift)
+
+
+def _lint_sha_words(findings, rel: str, tree: ast.Module):
+    # Bare-literal operands are fine inside a dtype-cast call like
+    # np.uint32(32 - n): record every BinOp nested under such a call.
+    casted: set[int] = set()
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in DTYPE_CONSTRUCTORS):
+            for sub in ast.walk(node):
+                casted.add(id(sub))
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.BinOp) and isinstance(node.op, _BITWISE)
+                and id(node) not in casted):
+            for side in (node.left, node.right):
+                if (isinstance(side, ast.Constant)
+                        and isinstance(side.value, int)):
+                    findings.append(Finding(
+                        rel, node.lineno, "JAX004",
+                        f"bare int literal {side.value} in "
+                        f"{type(node.op).__name__} word arithmetic — wrap "
+                        f"it in np.uint32(...) to pin the SHA word dtype"))
+                    break
+
+
+def _canonical_axes(mesh_py: pathlib.Path) -> set[str]:
+    """Axis names from every make_mesh/Mesh axis tuple in parallel/mesh.py
+    — the single source of truth the rest of the tree must draw from."""
+    axes: set[str] = set()
+    try:
+        tree = ast.parse(mesh_py.read_text(), filename=str(mesh_py))
+    except (OSError, SyntaxError):
+        return axes
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _call_name(node) in (
+                "make_mesh", "Mesh"):
+            for arg in list(node.args) + [k.value for k in node.keywords]:
+                if isinstance(arg, (ast.Tuple, ast.List)):
+                    for e in arg.elts:
+                        if (isinstance(e, ast.Constant)
+                                and isinstance(e.value, str)):
+                            axes.add(e.value)
+    return axes
+
+
+def _axis_strings(node: ast.Call) -> list[tuple[str, int]]:
+    """String axis names used by this call, with line numbers."""
+    out: list[tuple[str, int]] = []
+    name = _call_name(node)
+    candidates: list[ast.expr] = []
+    if name in AXIS_CALLS:
+        slot = AXIS_CALLS[name]
+        if len(node.args) > slot:
+            candidates.append(node.args[slot])
+        candidates += [k.value for k in node.keywords
+                       if k.arg in ("axis_name", "axis")]
+    elif name in ("make_mesh", "Mesh"):
+        candidates += list(node.args) + [k.value for k in node.keywords]
+    elif name == "partial":
+        candidates += [k.value for k in node.keywords
+                       if k.arg == "axis_name"]
+    for c in candidates:
+        nodes = c.elts if isinstance(c, (ast.Tuple, ast.List)) else [c]
+        for e in nodes:
+            if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                out.append((e.value, e.lineno))
+    return out
+
+
+def _lint_axis_names(findings, rel: str, tree: ast.Module,
+                     canonical: set[str]):
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            for axis, lineno in _axis_strings(node):
+                if axis not in canonical:
+                    findings.append(Finding(
+                        rel, lineno, "JAX005",
+                        f"mesh axis name '{axis}' is not in the canonical "
+                        f"set {sorted(canonical)} from parallel/mesh.py"))
+        elif isinstance(node, ast.FunctionDef):
+            args = node.args
+            for a, d in zip(args.args[len(args.args)
+                                      - len(args.defaults):],
+                            args.defaults):
+                if (a.arg == "axis_name" and isinstance(d, ast.Constant)
+                        and isinstance(d.value, str)
+                        and d.value not in canonical):
+                    findings.append(Finding(
+                        rel, d.lineno, "JAX005",
+                        f"axis_name default '{d.value}' is not in the "
+                        f"canonical set {sorted(canonical)}"))
+
+
+def run_jax_lint(root: pathlib.Path, overrides=None,
+                 notes=None) -> list[Finding]:
+    overrides = overrides or {}
+    pkg = root / "mpi_blockchain_tpu"
+    mesh_py = overrides.get("mesh_py", pkg / "parallel" / "mesh.py")
+    canonical = _canonical_axes(mesh_py)
+
+    files: list[pathlib.Path] = list(overrides.get("jax_files", []))
+    if not files:
+        for d in LINT_DIRS:
+            files.extend(sorted((pkg / d).glob("*.py")))
+
+    if not canonical and notes is not None:
+        notes.append("jax: no canonical mesh axes found; JAX005 skipped")
+
+    findings: list[Finding] = []
+    for path in files:
+        rel = str(path.relative_to(root)) if path.is_relative_to(root) \
+            else str(path)
+        try:
+            tree = ast.parse(path.read_text(), filename=str(path))
+        except SyntaxError as e:
+            findings.append(Finding(rel, e.lineno or 1, "JAX000",
+                                    f"syntax error: {e.msg}"))
+            continue
+        for tf in _collect_traced_functions(tree):
+            _lint_traced_fn(findings, rel, tf)
+        if any(rel.replace("\\", "/").endswith(m)
+               for m in SHA_WORD_MODULES) or "jax_files" in overrides:
+            _lint_sha_words(findings, rel, tree)
+        if canonical:
+            _lint_axis_names(findings, rel, tree, canonical)
+    return findings

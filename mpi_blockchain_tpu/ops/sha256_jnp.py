@@ -45,6 +45,12 @@ IV = np.array([0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
 _U32 = jnp.uint32
 NOT_FOUND_U32 = np.uint32(0xFFFFFFFF)
 
+# The nonce's position in the header's second SHA-256 chunk: byte offset
+# 76 of the frozen layout (chain.hpp) = 64 + NONCE_WORD_INDEX * 4. Both
+# device kernels substitute the swept nonce at this word; chainlint HDR004
+# cross-checks the value against the C++ struct layout.
+NONCE_WORD_INDEX = 3
+
 
 def _rotr(x, n: int):
     return (x >> np.uint32(n)) | (x << np.uint32(32 - n))
@@ -116,7 +122,8 @@ def sha256d_words_from_midstate(midstate, tail_w, nonce_word):
     (digest bytes are their big-endian concatenation).
     """
     st = tuple(midstate[i] for i in range(8))
-    w = [tail_w[i] if i != 3 else nonce_word for i in range(16)]
+    w = [tail_w[i] if i != NONCE_WORD_INDEX else nonce_word
+         for i in range(16)]
     d1 = compress(st, w)
     # Second hash: digest-1 words are the message words directly (the digest
     # bytes are their BE encoding, and SHA reads words BE — no swap).

@@ -34,7 +34,7 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .sha256_jnp import IV, K, NOT_FOUND_U32
+from .sha256_jnp import IV, K, NONCE_WORD_INDEX, NOT_FOUND_U32
 
 _U32 = jnp.uint32
 _LANES = 128
@@ -109,7 +109,8 @@ def _tile_result(midstate_ref, tail_ref, base, *, difficulty_bits: int):
     nonces = base + row * np.uint32(_LANES) + lane
 
     # Chunk 2 of the first hash: uniform words from SMEM, nonce in word 3.
-    w1 = [tail_ref[i] if i != 3 else _bswap32(nonces) for i in range(16)]
+    w1 = [tail_ref[i] if i != NONCE_WORD_INDEX else _bswap32(nonces)
+          for i in range(16)]
     st1 = tuple(midstate_ref[i] for i in range(8))
     d1 = _compress_unrolled(st1, w1)
     # Second hash: one padded chunk whose first 8 words are digest 1.

@@ -23,6 +23,35 @@ from ..config import ConfigError
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+# The ONE shard_map compat seam: every shard_map in the repo (and in
+# tests) goes through this name with the modern check_vma spelling.
+# Keyed on the actual kwarg, not the export location: some versions
+# export top-level jax.shard_map that still spells the replication
+# check check_rep.
+def _resolve_shard_map():
+    import inspect
+    fn = getattr(jax, "shard_map", None)
+    if fn is None:
+        from jax.experimental.shard_map import shard_map as fn
+    try:
+        params = inspect.signature(fn).parameters
+    except (TypeError, ValueError):
+        params = {}
+    if "check_vma" in params:
+        return fn
+
+    def compat(f, *, mesh, in_specs, out_specs, check_vma=None, **kw):
+        # check_rep-era shard_map has no replication rule for while_loop
+        # (the multi-round searcher), so it defaults off here; an
+        # explicit check_vma choice is still honored.
+        kw["check_rep"] = bool(check_vma) if check_vma is not None else False
+        return fn(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  **kw)
+    return compat
+
+
+shard_map = _resolve_shard_map()
+
 _U32 = jnp.uint32
 
 
@@ -92,9 +121,9 @@ def maybe_shard_over_miners(fn, n_miners: int, mesh: Mesh | None,
             raise ConfigError(
                 f"mesh has {mesh.size} devices but n_miners={n_miners}; "
                 f"the 'miners' axis must match the round split exactly")
-        sharded = jax.shard_map(functools.partial(fn, axis_name="miners"),
-                                mesh=mesh, in_specs=(P(),) * n_in,
-                                out_specs=(P(),) * n_out)
+        sharded = shard_map(functools.partial(fn, axis_name="miners"),
+                            mesh=mesh, in_specs=(P(),) * n_in,
+                            out_specs=(P(),) * n_out)
         return jax.jit(sharded)
     return jax.jit(functools.partial(fn, axis_name=None))
 
@@ -180,6 +209,6 @@ def make_mesh_sweep_fn(mesh: Mesh, batch_size: int, difficulty_bits: int,
                                  sharded_local_base(base, batch_size))
         return winner_select(count, min_nonce)
 
-    sharded = jax.shard_map(per_device, mesh=mesh,
-                            in_specs=(P(), P(), P()), out_specs=(P(), P()))
+    sharded = shard_map(per_device, mesh=mesh,
+                        in_specs=(P(), P(), P()), out_specs=(P(), P()))
     return jax.jit(sharded)

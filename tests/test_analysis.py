@@ -1,0 +1,217 @@
+"""chainlint subsystem tests (mpi_blockchain_tpu/analysis).
+
+The drift fixtures are generated from the LIVE sources with targeted
+regex edits, so they stay in sync with the real files forever: a fixture
+is the real capi.cpp/chain.hpp plus exactly the deliberate drift under
+test, and the assertions are on exact rule ids.
+"""
+import pathlib
+import re
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from mpi_blockchain_tpu.analysis import run_all
+from mpi_blockchain_tpu.analysis.jax_lint import run_jax_lint
+from mpi_blockchain_tpu.analysis.sanitizers import run_sanitizers
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+CORE_SRC = ROOT / "mpi_blockchain_tpu" / "core" / "src"
+
+
+def rule_set(findings):
+    return {f.rule for f in findings}
+
+
+# ---- clean tree --------------------------------------------------------
+
+
+def test_clean_tree_zero_findings():
+    notes = []
+    findings = run_all(root=ROOT, notes=notes)
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_cli_clean_tree_exits_zero():
+    proc = subprocess.run(
+        [sys.executable, "-m", "mpi_blockchain_tpu.analysis"],
+        cwd=ROOT, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "0 finding(s)" in proc.stderr
+
+
+# ---- drift fixture 1: binding signature drift --------------------------
+
+
+@pytest.fixture
+def drifted_capi(tmp_path):
+    """Real capi.cpp with three deliberate drifts: cc_search loses its
+    hashes_tried out-param (arity), cc_node_difficulty's return widens to
+    uint64_t (restype), and a cc_phantom export appears (unbound)."""
+    text = (CORE_SRC / "capi.cpp").read_text()
+    drifted, n = re.subn(
+        r"cc_search\([^)]*\)",
+        "cc_search(const uint8_t* header80, uint64_t start_nonce,\n"
+        "                   uint64_t count, uint32_t difficulty_bits)",
+        text, count=1)
+    assert n == 1
+    drifted, n = re.subn(r"uint32_t cc_node_difficulty\(",
+                         "uint64_t cc_node_difficulty(", drifted, count=1)
+    assert n == 1
+    drifted = drifted.replace(
+        '}  // extern "C"',
+        'void cc_phantom(uint32_t x) { (void)x; }\n\n}  // extern "C"')
+    path = tmp_path / "capi.cpp"
+    path.write_text(drifted)
+    return path
+
+
+def test_drifted_signature_fires_exact_rules(drifted_capi):
+    findings = run_all(root=ROOT, passes=["binding"],
+                       overrides={"capi": drifted_capi})
+    rules = rule_set(findings)
+    assert "BIND002" in rules   # cc_search arity drift
+    assert "BIND004" in rules   # cc_node_difficulty restype drift
+    assert "BIND001" in rules   # cc_phantom unbound
+    by_rule = {f.rule: f.message for f in findings}
+    assert "cc_search" in by_rule["BIND002"]
+    assert "cc_node_difficulty" in by_rule["BIND004"]
+    assert "cc_phantom" in by_rule["BIND001"]
+
+
+def test_cli_drifted_signature_exits_nonzero(drifted_capi):
+    proc = subprocess.run(
+        [sys.executable, "-m", "mpi_blockchain_tpu.analysis",
+         "--passes", "binding", "--override", f"capi={drifted_capi}"],
+        cwd=ROOT, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "BIND002" in proc.stdout
+
+
+# ---- drift fixture 2: reordered header field ---------------------------
+
+
+@pytest.fixture
+def reordered_chain_hpp(tmp_path):
+    """Real chain.hpp with nonce moved ahead of timestamp/bits — the byte
+    layout every backend froze, silently reordered."""
+    text = (CORE_SRC / "chain.hpp").read_text()
+    block = ("  uint32_t timestamp = 0;\n"
+             "  uint32_t bits = 0;\n"
+             "  uint32_t nonce = 0;\n")
+    assert block in text
+    reordered = text.replace(
+        block,
+        "  uint32_t nonce = 0;\n"
+        "  uint32_t timestamp = 0;\n"
+        "  uint32_t bits = 0;\n")
+    path = tmp_path / "chain.hpp"
+    path.write_text(reordered)
+    return path
+
+
+def test_reordered_header_field_fires_hdr001(reordered_chain_hpp):
+    findings = run_all(root=ROOT, passes=["header"],
+                       overrides={"chain_hpp": reordered_chain_hpp})
+    rules = rule_set(findings)
+    assert "HDR001" in rules
+    msg = next(f.message for f in findings if f.rule == "HDR001")
+    assert "nonce" in msg and "timestamp" in msg
+
+
+def test_cli_reordered_header_exits_nonzero(reordered_chain_hpp):
+    proc = subprocess.run(
+        [sys.executable, "-m", "mpi_blockchain_tpu.analysis",
+         "--passes", "header", "--override",
+         f"chain_hpp={reordered_chain_hpp}"],
+        cwd=ROOT, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "HDR001" in proc.stdout
+
+
+def test_shrunk_header_fires_hdr002(tmp_path):
+    text = (CORE_SRC / "chain.hpp").read_text()
+    shrunk = text.replace("uint8_t prev_hash[32]", "uint8_t prev_hash[28]")
+    path = tmp_path / "chain.hpp"
+    path.write_text(shrunk)
+    findings = run_all(root=ROOT, passes=["header"],
+                       overrides={"chain_hpp": path})
+    assert {"HDR001", "HDR002"} <= rule_set(findings)
+
+
+# ---- JAX lint rules ----------------------------------------------------
+
+
+BAD_JAX = textwrap.dedent("""\
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+
+    @jax.jit
+    def f(x):
+        if x > 0:                        # JAX001: traced branch
+            x = x + 1
+        y = np.cumsum(x)                 # JAX003: numpy in jit
+        jax.debug.print("x={}", x)       # JAX002: host callback
+        z = x >> 3                       # JAX004: bare literal shift
+        w = jax.lax.axis_index("colz")   # JAX005: axis in arg slot 0
+        return jax.lax.psum(z + y + w, "rows")   # JAX005: bad axis
+
+
+    @functools.partial(jax.jit, static_argnames=("k",))
+    def g(x, k):
+        if k > 0:                        # fine: k is static
+            return x + np.uint32(k)      # fine: dtype constructor
+        return x
+    """)
+
+
+def test_jax_lint_rules(tmp_path):
+    bad = tmp_path / "bad_kernel.py"
+    bad.write_text(BAD_JAX)
+    findings = run_jax_lint(ROOT, overrides={"jax_files": [bad]})
+    rules = rule_set(findings)
+    assert rules == {"JAX001", "JAX002", "JAX003", "JAX004", "JAX005"}
+    # The static-argnames branch in g() must NOT fire JAX001.
+    assert all("'g'" not in f.message for f in findings)
+
+
+def test_jax_lint_inline_suppression(tmp_path):
+    suppressed = BAD_JAX.replace(
+        "y = np.cumsum(x)                 # JAX003: numpy in jit",
+        "y = np.cumsum(x)  # chainlint: disable=JAX003")
+    bad = tmp_path / "bad_kernel.py"
+    bad.write_text(suppressed)
+    findings = run_all(root=tmp_path, passes=["jax"],
+                       overrides={"jax_files": [bad],
+                                  "mesh_py":
+                                  ROOT / "mpi_blockchain_tpu" / "parallel"
+                                  / "mesh.py"})
+    rules = rule_set(findings)
+    assert "JAX003" not in rules
+    assert "JAX001" in rules    # the others still fire
+
+
+# ---- sanitizer matrix --------------------------------------------------
+
+
+def test_sanitizer_matrix_rules(tmp_path):
+    makefile = tmp_path / "Makefile"
+    makefile.write_text("sanity_tsan:\n\techo t\n\nsanity_asan:\n\techo a\n")
+    findings = run_sanitizers(
+        ROOT, overrides={"core_makefile": makefile,
+                         "core_src": tmp_path / "nosrc"})
+    rules = rule_set(findings)
+    assert "SAN001" in rules    # ubsan flavor missing
+    assert "SAN002" in rules    # analyze target missing
+    assert any("ubsan" in f.message for f in findings)
+
+
+def test_real_makefile_has_full_matrix():
+    findings = run_sanitizers(ROOT, notes=[])
+    assert not [f for f in findings if f.rule in ("SAN001", "SAN002")]

@@ -31,6 +31,7 @@ from conftest import needs_devices
 
 from mpi_blockchain_tpu import core
 from mpi_blockchain_tpu.ops import sha256_pallas as sp
+from mpi_blockchain_tpu.parallel.mesh import shard_map
 
 # ---- half 1: production tile math, eagerly, vs the C++ oracle -------------
 
@@ -143,6 +144,10 @@ def test_out_vma_derivation_under_check_vma_trace():
     from mpi_blockchain_tpu.parallel.mesh import (make_miner_mesh,
                                                   sharded_local_base)
 
+    if getattr(jax, "typeof", None) is None:
+        pytest.skip("jax.typeof (vma machinery) absent on this jax; "
+                    "_out_vma degrades to empty sets by design")
+
     captured = {}
 
     def f(base):
@@ -151,8 +156,8 @@ def test_out_vma_derivation_under_check_vma_trace():
         captured["union"] = sp._out_vma(base, varying)
         return jax.lax.pmax(varying, "miners")
 
-    fn = jax.shard_map(f, mesh=make_miner_mesh(4), in_specs=(P(),),
-                       out_specs=P())
+    fn = shard_map(f, mesh=make_miner_mesh(4), in_specs=(P(),),
+                   out_specs=P())
     jax.eval_shape(fn, jax.ShapeDtypeStruct((), jnp.uint32))
     assert captured["replicated"] == frozenset()
     assert captured["union"] == frozenset({"miners"})
@@ -190,9 +195,9 @@ def test_sharded_pallas_under_shard_map(monkeypatch):
         c, m = sweep(midstate, tail_w, sharded_local_base(base, batch))
         return winner_select(c, m)
 
-    fn = jax.jit(jax.shard_map(per_device, mesh=make_miner_mesh(n_miners),
-                               in_specs=(P(), P(), P()),
-                               out_specs=(P(), P()), check_vma=False))
+    fn = jax.jit(shard_map(per_device, mesh=make_miner_mesh(n_miners),
+                           in_specs=(P(), P(), P()),
+                           out_specs=(P(), P()), check_vma=False))
     tail = np.zeros(16, np.uint32)
     tail[0] = q
     count, mn = fn(np.zeros(8, np.uint32), tail, np.uint32(1))
@@ -234,7 +239,7 @@ def test_multiround_searcher_with_interpret_pallas_on_8_mesh(
     sweep = functools.partial(sp.pallas_sweep_core, batch_size=batch,
                               difficulty_bits=8, interpret=True)
     run = make_round_search(sweep, batch, round_size)
-    fn = jax.jit(jax.shard_map(
+    fn = jax.jit(shard_map(
         functools.partial(run, axis_name="miners"),
         mesh=make_miner_mesh(n_miners), in_specs=(P(),) * 4,
         out_specs=(P(),) * 3, check_vma=False))   # interpret-mode-only

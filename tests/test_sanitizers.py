@@ -1,10 +1,10 @@
-"""Race/memory detection build flavors (SURVEY.md §5).
+"""Race/memory/UB detection build flavors (SURVEY.md §5).
 
-Builds the C++ core with -fsanitize=thread and -fsanitize=address and runs
+Builds the C++ core with -fsanitize={thread,address,undefined} and runs
 the sanity driver, which reproduces the production threading pattern:
 parallel nonce search threads over a shared header plus the chain
 append/fork/reorg state machine. The sanitizers make the process exit
-non-zero on any race or memory error.
+non-zero on any race, memory error, or undefined behavior.
 """
 import pathlib
 import shutil
@@ -16,7 +16,7 @@ CORE = pathlib.Path(__file__).resolve().parent.parent / \
     "mpi_blockchain_tpu" / "core"
 
 
-@pytest.mark.parametrize("flavor", ["tsan", "asan"])
+@pytest.mark.parametrize("flavor", ["tsan", "asan", "ubsan"])
 def test_sanitizer_flavor(flavor):
     if shutil.which("g++") is None:
         pytest.skip("no g++")
@@ -26,7 +26,8 @@ def test_sanitizer_flavor(flavor):
         # Only a genuinely missing sanitizer runtime may skip; a compile
         # error in the driver or core headers must FAIL the test.
         missing = ("cannot find" in build.stderr
-                   and ("tsan" in build.stderr or "asan" in build.stderr))
+                   and any(s in build.stderr
+                           for s in ("tsan", "asan", "ubsan")))
         if missing:
             pytest.skip(f"sanitizer runtime unavailable: {build.stderr[-200:]}")
         pytest.fail(f"sanitizer build failed:\n{build.stderr[-2000:]}")
