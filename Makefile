@@ -4,13 +4,25 @@
 PY ?= python3
 SHELL := /bin/bash   # tier1 uses pipefail/PIPESTATUS
 
-.PHONY: check lint tier1 core clean
+.PHONY: check lint metrics-smoke tier1 core clean
 
-check: lint tier1
+check: lint metrics-smoke tier1
 
 # chainlint: binding contract, header layout, JAX purity, sanitizer matrix.
 lint:
 	$(PY) -m mpi_blockchain_tpu.analysis
+
+# Telemetry smoke: the instrumented mini-run (mine + faulted sim) must
+# exit 0 and emit a Prometheus snapshot with the headline counters.
+metrics-smoke:
+	out=$$(env JAX_PLATFORMS=cpu $(PY) -m mpi_blockchain_tpu.telemetry \
+	    --steps 3 2>/dev/null) || \
+	    { echo "metrics-smoke: telemetry CLI failed"; exit 1; }; \
+	echo "$$out" | grep -q '^mining_rounds_total' && \
+	echo "$$out" | grep -q '^hashes_tried_total' && \
+	echo "$$out" | grep -q '_count' || \
+	    { echo "metrics-smoke: required metrics missing"; exit 1; }; \
+	echo "metrics-smoke: ok ($$(echo "$$out" | wc -l) snapshot lines)"
 
 # Tier-1 verify, verbatim from ROADMAP.md.
 tier1:

@@ -14,6 +14,9 @@ TPU, so they are linted statically:
   JAX004  bare int literal in bitwise/shift SHA word arithmetic (dtype
           promotion risk; wrap in np.uint32/jnp.uint32)
   JAX005  mesh axis name not in the canonical set from parallel/mesh.py
+  JAX006  telemetry call (counter/gauge/histogram/span/emit_event, or any
+          telemetry.* function) inside a traced function — metrics and
+          spans are host work; in the hot path they become host callbacks
 
 "Traced function" is detected structurally: decorated with jax.jit (bare
 or via functools.partial with static_argnames), wrapped by a jax.jit(...)
@@ -41,6 +44,10 @@ DTYPE_CONSTRUCTORS = {
     "int64", "float16", "float32", "float64", "bool_", "dtype",
 }
 HOST_CALLBACK_NAMES = {"pure_callback", "io_callback", "host_callback"}
+# The telemetry public API (mpi_blockchain_tpu/telemetry): bare-name calls
+# to these, or any call on a module path containing 'telemetry', are host
+# metric/span work and must stay outside the jit boundary (JAX006).
+TELEMETRY_FUNCS = {"counter", "gauge", "histogram", "span", "emit_event"}
 HOST_SYNC_METHODS = {"item", "tolist", "block_until_ready", "copy_to_host",
                      "__array__"}
 # Calls that trace a function argument -> which positional slots hold it.
@@ -189,6 +196,14 @@ def _lint_traced_fn(findings, rel: str, tf: TracedFn):
                     rel, node.lineno, "JAX002",
                     f"host-sync call '.{name}()' inside traced function "
                     f"'{tf.node.name}'"))
+            elif ("telemetry" in dotted.split(".")[:-1]
+                    or (isinstance(node.func, ast.Name)
+                        and name in TELEMETRY_FUNCS)):
+                findings.append(Finding(
+                    rel, node.lineno, "JAX006",
+                    f"telemetry call '{dotted or name}' inside traced "
+                    f"function '{tf.node.name}' — metrics/spans are host "
+                    f"work; record them outside the jit boundary"))
             elif (isinstance(node.func, ast.Attribute)
                     and isinstance(node.func.value, ast.Name)
                     and node.func.value.id in ("np", "numpy")

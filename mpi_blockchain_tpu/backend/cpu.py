@@ -10,6 +10,7 @@ from __future__ import annotations
 import concurrent.futures
 
 from .. import core
+from ..telemetry.spans import span
 from . import MinerBackend, SearchResult, register
 
 
@@ -23,14 +24,15 @@ class CpuBackend(MinerBackend):
 
     def search(self, header80: bytes, difficulty_bits: int,
                start_nonce: int = 0, max_count: int = 1 << 32) -> SearchResult:
-        if self.n_ranks == 1:
-            nonce, tried = core.cpu_search(header80, start_nonce, max_count,
-                                           difficulty_bits)
-            digest = (core.header_hash(core.set_nonce(header80, nonce))
-                      if nonce is not None else None)
-            return SearchResult(nonce, digest, tried)
-        return self._search_ranks(header80, difficulty_bits, start_nonce,
-                                  max_count)
+        with span("backend.cpu.search", n_ranks=self.n_ranks):
+            if self.n_ranks == 1:
+                nonce, tried = core.cpu_search(header80, start_nonce,
+                                               max_count, difficulty_bits)
+                digest = (core.header_hash(core.set_nonce(header80, nonce))
+                          if nonce is not None else None)
+                return SearchResult(nonce, digest, tried)
+            return self._search_ranks(header80, difficulty_bits, start_nonce,
+                                      max_count)
 
     def _search_ranks(self, header80: bytes, difficulty_bits: int,
                       start_nonce: int, max_count: int) -> SearchResult:

@@ -26,6 +26,8 @@ from __future__ import annotations
 import numpy as np
 
 from .. import core
+from ..telemetry import counter
+from ..telemetry.spans import span
 from . import MinerBackend, SearchResult, register
 
 NONCE_SPACE = 1 << 32
@@ -104,10 +106,21 @@ class TpuBackend(MinerBackend):
             n_rounds = min(-(-(end - base) // round_size),
                            (NONCE_SPACE - base) // round_size, 0xFFFFFFFF)
         if n_rounds > 0:
-            out = self._searcher(difficulty_bits)(
-                midstate, tail, np.uint32(base), np.uint32(n_rounds))
-            rounds, count, min_nonce = (
-                int(v) for v in replicated_host_values(out))
+            # The span covers dispatch AND the value materialization below
+            # — the device-side share of the search (vs the CPU tail's
+            # host share), the split docs/observability.md documents.
+            with span("backend.tpu.dispatch",
+                      difficulty=difficulty_bits, n_rounds=n_rounds):
+                out = self._searcher(difficulty_bits)(
+                    midstate, tail, np.uint32(base), np.uint32(n_rounds))
+                rounds, count, min_nonce = (
+                    int(v) for v in replicated_host_values(out))
+            counter("device_dispatches_total",
+                    help="jit'd multi-round search programs dispatched",
+                    backend="tpu").inc()
+            counter("device_rounds_total",
+                    help="sweep rounds executed on-device",
+                    backend="tpu").inc(rounds)
             if rounds > 0:
                 # Same accounting as one host-checked round at a time:
                 # every executed round counts in full, except the final
@@ -124,8 +137,9 @@ class TpuBackend(MinerBackend):
                                     tried)
             base += rounds * round_size
         if base < end:
-            nonce, t = core.cpu_search(header80, base, end - base,
-                                       difficulty_bits)
+            with span("backend.tpu.host_tail"):
+                nonce, t = core.cpu_search(header80, base, end - base,
+                                           difficulty_bits)
             tried += t
             if nonce is not None:
                 winner = core.set_nonce(header80, nonce)

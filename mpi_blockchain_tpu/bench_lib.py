@@ -12,6 +12,7 @@ import concurrent.futures
 import time
 
 from . import core
+from .telemetry import counter, gauge
 
 _IMPOSSIBLE_DIFFICULTY = 64  # no 64-leading-zero-bit hash will be found
 _HEADER = bytes(range(80))   # arbitrary fixed header; content is irrelevant
@@ -20,6 +21,13 @@ _HEADER = bytes(range(80))   # arbitrary fixed header; content is irrelevant
 def bench_cpu(seconds: float = 3.0, n_miners: int = 1,
               chunk: int = 1 << 18) -> dict:
     """C++ scalar sweep throughput over n_miners threads (GIL released)."""
+    # Shared across the GIL-free rank threads — the live thread-safety
+    # proof for the registry (tests/test_telemetry.py asserts this
+    # counter exactly matches the summed per-rank totals).
+    hashes_c = counter("bench_hashes_total",
+                       help="nonces hashed by the bench sweep",
+                       backend="cpu")
+
     def one_rank(rank: int) -> int:
         tried = 0
         deadline = time.perf_counter() + seconds
@@ -28,6 +36,7 @@ def bench_cpu(seconds: float = 3.0, n_miners: int = 1,
             _, t = core.cpu_search(_HEADER, base, chunk,
                                    _IMPOSSIBLE_DIFFICULTY)
             tried += t
+            hashes_c.inc(t)
             base += chunk
         return tried
 
@@ -38,6 +47,9 @@ def bench_cpu(seconds: float = 3.0, n_miners: int = 1,
         with concurrent.futures.ThreadPoolExecutor(n_miners) as pool:
             total = sum(pool.map(one_rank, range(n_miners)))
     wall = time.perf_counter() - t0
+    gauge("bench_hashes_per_sec",
+          help="last measured sweep throughput",
+          backend="cpu").set(total / wall)
     return {"backend": "cpu", "n_miners": n_miners,
             "hashes": total, "wall_s": round(wall, 3),
             "hashes_per_sec": total / wall,
@@ -94,6 +106,11 @@ def bench_tpu(seconds: float = 5.0, batch_pow2: int = 28,
     for r in pending:
         int(r[0])
     wall = time.perf_counter() - t0
+    counter("bench_hashes_total",
+            help="nonces hashed by the bench sweep", backend="tpu").inc(tried)
+    gauge("bench_hashes_per_sec",
+          help="last measured sweep throughput", backend="tpu").set(
+        tried / wall)
     return {"backend": "tpu", "n_miners": n_miners, "kernel": kernel,
             "batch_pow2": batch_pow2, "platform": jax.default_backend(),
             "hashes": tried, "wall_s": round(wall, 3),
@@ -132,6 +149,9 @@ def bench_chain(n_blocks: int = 1000, difficulty_bits: int = 24,
     # Full PoW + linkage re-validation through the C++ chain loader.
     if not core.Node(difficulty_bits, 0).load(node.save()):
         raise RuntimeError("mined chain failed validation")
+    gauge("bench_blocks_per_sec",
+          help="last measured full-chain mining rate",
+          backend="tpu-fused").set(n_blocks / wall)
     return {"n_blocks": n_blocks, "difficulty_bits": difficulty_bits,
             "n_miners": n_miners, "wall_s": round(wall, 3),
             "blocks_per_sec": n_blocks / wall,

@@ -45,6 +45,12 @@ def _add_config_args(p: argparse.ArgumentParser) -> None:
                         "track the difficulty (clamped to [13, 24])")
 
 
+def _add_metrics_dump_arg(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--metrics-dump", metavar="PATH", default=None,
+                   help="write a Prometheus text snapshot of the run's "
+                        "telemetry registry to PATH on exit")
+
+
 def _config_from(args) -> MinerConfig:
     if args.preset:
         return PRESETS[args.preset]
@@ -279,6 +285,7 @@ def main(argv: list[str] | None = None) -> int:
     p_mine.add_argument("--profile",
                         help="capture a jax.profiler device trace into this "
                              "logdir (view with ui.perfetto.dev)")
+    _add_metrics_dump_arg(p_mine)
     p_mine.add_argument("--coordinator",
                         help="multi-process launch: coordinator host:port "
                              "(run the same command on every host; the "
@@ -318,6 +325,7 @@ def main(argv: list[str] | None = None) -> int:
     p_bench.add_argument("--difficulty", type=int, default=24,
                          help="chain mode: leading-zero bits")
     p_bench.add_argument("--blocks-per-call", type=int, default=100)
+    _add_metrics_dump_arg(p_bench)
     p_bench.set_defaults(fn=cmd_bench)
 
     p_sim = sub.add_parser(
@@ -344,6 +352,7 @@ def main(argv: list[str] | None = None) -> int:
                        help="seed for the drop schedule")
     p_sim.add_argument("--groups", type=int, default=2,
                        help="number of competing miner groups")
+    _add_metrics_dump_arg(p_sim)
     p_sim.set_defaults(fn=cmd_sim)
 
     p_info = sub.add_parser("info", help="world/topology introspection "
@@ -363,6 +372,17 @@ def main(argv: list[str] | None = None) -> int:
         print(json.dumps({"event": "error", "error": str(e)},
                          sort_keys=True))
         return 2
+    finally:
+        # Dump on EVERY exit path, rc != 0 and raises included (e.g. a
+        # non-converged sim or an exhausted nonce space): the metrics of
+        # a failed run are exactly what a post-mortem needs. A dump
+        # failure must not mask the run's own outcome.
+        if getattr(args, "metrics_dump", None):
+            from .telemetry import dump_metrics
+            try:
+                dump_metrics(args.metrics_dump)
+            except OSError as e:
+                print(f"metrics-dump failed: {e}", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -31,6 +31,8 @@ import numpy as np
 
 from .. import core
 from ..config import MAX_EXTRA_NONCE, ConfigError, extend_payload
+from ..telemetry import counter
+from ..telemetry.spans import span
 from ..ops.sha256_jnp import (IV, _bswap32, compress,
                               sha256d_words_from_midstate)
 from ..parallel.mesh import replicated_host_value
@@ -210,8 +212,12 @@ class FusedMiner:
                         for j in range(k)]
             data_words = np.stack([_words_be(core.sha256d(p))
                                    for p in payloads])
-            nonces, prev = self._fn(k)(prev, jnp.asarray(data_words),
-                                       np.uint32(height))
+            with span("fused.dispatch", k=k, height=height):
+                nonces, prev = self._fn(k)(prev, jnp.asarray(data_words),
+                                           np.uint32(height))
+            counter("device_dispatches_total",
+                    help="jit'd multi-round search programs dispatched",
+                    backend="tpu-fused").inc()
             batches.append((height, payloads, nonces))
             height += k
             remaining -= k
@@ -226,10 +232,15 @@ class FusedMiner:
             for j, payload in enumerate(payloads):
                 cand = self.node.make_candidate(payload)
                 winner = core.set_nonce(cand, int(nonces[j]))
-                if not self.node.submit(winner):
+                with span("miner.append", height=batch_height + j + 1):
+                    accepted = self.node.submit(winner)
+                if not accepted:
                     self._recover_block(batch_height + j + 1,
                                         int(nonces[j]))
                     return self.node.height - start
+                counter("blocks_mined_total",
+                        help="blocks mined and appended",
+                        backend="tpu-fused").inc()
                 self._log({"event": "block_mined", "backend": "tpu-fused",
                            "height": batch_height + j + 1,
                            "nonce": int(nonces[j]),
@@ -267,6 +278,8 @@ class FusedMiner:
                 raise RuntimeError(
                     f"rollover block failed validation at height {height} "
                     f"(extra_nonce {extra_nonce}, nonce {res.nonce:#010x})")
+            counter("blocks_mined_total", help="blocks mined and appended",
+                    backend="tpu-fused").inc()
             self._log({"event": "block_mined",
                        "backend": "tpu-fused/rollover", "height": height,
                        "extra_nonce": extra_nonce, "nonce": res.nonce,

@@ -14,6 +14,8 @@ from typing import Callable
 from .. import core
 from ..backend import MinerBackend, backend_from_config
 from ..config import MAX_EXTRA_NONCE, MinerConfig, extend_payload
+from ..telemetry import counter, histogram
+from ..telemetry.spans import span
 from ..utils.logging import block_logger
 
 
@@ -55,27 +57,46 @@ class Miner:
         height = self.node.height + 1
         if data is None:
             data = self.config.payload(height)
+        backend = self.backend.name
         t0 = time.perf_counter()
         tried = 0
-        for extra_nonce in range(MAX_EXTRA_NONCE + 1):
-            cand = self.node.make_candidate(
-                extend_payload(data, extra_nonce))
-            res = self.backend.search(cand, self.config.difficulty_bits)
-            tried += res.hashes_tried
-            if res.nonce is not None:
-                break
-            self._log({"event": "nonce_space_exhausted", "height": height,
-                       "extra_nonce": extra_nonce + 1})
-        else:
-            raise RuntimeError(
-                f"{MAX_EXTRA_NONCE} consecutive empty nonce spaces at "
-                f"height {height} — difficulty "
-                f"{self.config.difficulty_bits} is unsatisfiably high")
-        wall_ms = (time.perf_counter() - t0) * 1e3
-        res = dataclasses.replace(res, hashes_tried=tried)
-        winner = core.set_nonce(cand, res.nonce)
-        if not self.node.submit(winner):
+        with span("miner.block", height=height):
+            for extra_nonce in range(MAX_EXTRA_NONCE + 1):
+                cand = self.node.make_candidate(
+                    extend_payload(data, extra_nonce))
+                with span("miner.sweep", height=height,
+                          extra_nonce=extra_nonce):
+                    res = self.backend.search(cand,
+                                              self.config.difficulty_bits)
+                counter("mining_rounds_total",
+                        help="backend sweep rounds issued",
+                        backend=backend).inc()
+                counter("hashes_tried_total",
+                        help="nonces evaluated across all sweeps",
+                        backend=backend).inc(res.hashes_tried)
+                tried += res.hashes_tried
+                if res.nonce is not None:
+                    break
+                self._log({"event": "nonce_space_exhausted",
+                           "height": height,
+                           "extra_nonce": extra_nonce + 1})
+            else:
+                raise RuntimeError(
+                    f"{MAX_EXTRA_NONCE} consecutive empty nonce spaces at "
+                    f"height {height} — difficulty "
+                    f"{self.config.difficulty_bits} is unsatisfiably high")
+            wall_ms = (time.perf_counter() - t0) * 1e3
+            res = dataclasses.replace(res, hashes_tried=tried)
+            winner = core.set_nonce(cand, res.nonce)
+            with span("miner.append", height=height):
+                accepted = self.node.submit(winner)
+        if not accepted:
             raise RuntimeError(f"backend returned invalid block at {height}")
+        counter("blocks_mined_total", help="blocks mined and appended",
+                backend=backend).inc()
+        histogram("block_latency_ms",
+                  help="wall-clock per mined block (winner latency, ms)",
+                  backend=backend).observe(wall_ms)
         rec = BlockRecord(height=height, nonce=res.nonce,
                           hash=res.hash.hex(), wall_ms=wall_ms,
                           hashes_tried=res.hashes_tried)

@@ -21,6 +21,7 @@ from typing import Callable
 from . import core
 from .backend import MinerBackend, backend_from_config
 from .config import ConfigError, MinerConfig, extend_payload
+from .telemetry import counter, gauge, histogram
 
 
 @dataclasses.dataclass
@@ -187,6 +188,12 @@ class SimNode:
             if rolled_back:
                 self.stats.reorgs += 1
                 self.stats.reorged_away_blocks += rolled_back
+                counter("sim_reorgs_total",
+                        help="chain reorganizations across all groups"
+                        ).inc()
+                histogram("sim_reorg_depth",
+                          help="blocks rolled back per reorg"
+                          ).observe(rolled_back)
         return res
 
 
@@ -217,6 +224,8 @@ class Network:
         return False
 
     def broadcast(self, sender: int, header80: bytes) -> None:
+        counter("sim_messages_sent_total",
+                help="block announcements enqueued on the bus").inc()
         self.queue.append(_Message(self.step_count,
                                    self.step_count + self.delay_steps,
                                    sender, header80))
@@ -244,10 +253,19 @@ class Network:
                     # destroys it.
                     if (self.partitioned_until is not None
                             and self.step_count < self.partitioned_until):
+                        counter("sim_messages_partition_deferred_total",
+                                help="deliveries deferred to the "
+                                     "partition heal").inc()
                         self.queue.append(dataclasses.replace(
                             m, deliver_step=self.partitioned_until))
+                    else:
+                        counter("sim_messages_dropped_total",
+                                help="deliveries lost to the drop "
+                                     "schedule").inc()
                     continue
                 node.receive(m.header80, sender_node)
+                counter("sim_messages_delivered_total",
+                        help="announcements delivered to a peer").inc()
 
     def step(self, nonce_budget: int = 1 << 16) -> None:
         """One simulation step: deliver, then every group mines a slice."""
@@ -257,6 +275,18 @@ class Network:
             if mined is not None:
                 self.broadcast(node.id, mined)
         self.step_count += 1
+        self.mirror_stats()
+
+    def mirror_stats(self) -> None:
+        """Mirrors every group's GroupStats (+ height) as labeled gauges
+        — the bus's counters see traffic; these see consensus state."""
+        for node in self.nodes:
+            g = str(node.id)
+            for name, value in dataclasses.asdict(node.stats).items():
+                gauge(f"sim_group_{name}", group=g).set(value)
+            gauge("sim_group_height",
+                  help="current chain height per group",
+                  group=g).set(node.node.height)
 
     def run(self, target_height: int, max_steps: int = 10_000,
             nonce_budget: int = 1 << 16) -> int:
@@ -274,6 +304,8 @@ class Network:
                 # Flush in-flight announcements (due up to delay_steps
                 # ahead of the clock), then check for one chain.
                 self.deliver_due(horizon=self.delay_steps)
+                # The flush can adopt/reorg after the last step's mirror.
+                self.mirror_stats()
                 if self.converged():
                     return self.step_count
         raise RuntimeError(f"no convergence in {max_steps} steps")

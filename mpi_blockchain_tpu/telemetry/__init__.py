@@ -1,0 +1,61 @@
+"""Unified telemetry: metrics registry, span tracing, and exporters.
+
+The observability layer for every stage of the stack (SURVEY.md §5;
+catalogue in docs/observability.md):
+
+* **registry** — process-local counters/gauges/histograms with bounded
+  reservoirs, thread-safe, identity = (name, labels).
+* **spans** — monotonic-clock spans with parent nesting via a
+  thread-local stack, mirrored into a ``span_seconds`` summary.
+* **exporters** —
+  1. JSON-lines event stream (``events.emit_event``; supersedes
+     ``utils.logging.block_logger``, which now delegates here),
+  2. Prometheus text snapshot (``render_prometheus()`` / the CLI
+     ``--metrics-dump PATH`` flag),
+  3. perfetto bridge (spans nest inside a ``utils.profiling.trace_mining``
+     jax.profiler capture via ``jax.profiler.TraceAnnotation``).
+
+All of it is HOST-side: telemetry calls inside jit-traced functions are a
+host callback in the hot path and are forbidden statically by chainlint
+rule JAX006. Standard library only — importing this package never pulls
+in jax.
+
+Smoke-run CLI: ``python -m mpi_blockchain_tpu.telemetry --steps 3`` mines
+a short instrumented chain + faulted simulation and prints the Prometheus
+snapshot (wired into ``make metrics-smoke``).
+"""
+from __future__ import annotations
+
+import pathlib
+
+from .events import clear_events, emit_event, recent_events  # noqa: F401
+from .registry import (Counter, Gauge, Histogram, MetricError,  # noqa: F401
+                       Registry, default_registry, reset)
+from .spans import (Span, active_span, disable_perfetto,  # noqa: F401
+                    enable_perfetto, perfetto_enabled, span)
+
+
+def counter(name: str, help: str = "", **labels) -> Counter:
+    """Get-or-create a counter on the default registry."""
+    return default_registry().counter(name, help=help, **labels)
+
+
+def gauge(name: str, help: str = "", **labels) -> Gauge:
+    """Get-or-create a gauge on the default registry."""
+    return default_registry().gauge(name, help=help, **labels)
+
+
+def histogram(name: str, help: str = "", **labels) -> Histogram:
+    """Get-or-create a histogram on the default registry."""
+    return default_registry().histogram(name, help=help, **labels)
+
+
+def render_prometheus() -> str:
+    return default_registry().render_prometheus()
+
+
+def dump_metrics(path: str | pathlib.Path) -> pathlib.Path:
+    """Writes the default registry's Prometheus snapshot to ``path``."""
+    path = pathlib.Path(path)
+    path.write_text(render_prometheus())
+    return path

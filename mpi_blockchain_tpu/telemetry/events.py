@@ -1,0 +1,47 @@
+"""JSON-lines event stream (exporter 1).
+
+One structured dict per notable occurrence (block mined, nonce space
+exhausted, sim reorg, ...), serialized as a JSON line through the package
+logger at INFO — the production form of the reference's std::cout prints,
+and the supersession of ``utils.logging.block_logger`` (which now
+delegates here). Events are additionally kept in a bounded in-process
+ring so the telemetry CLI and tests can inspect what a run emitted
+without scraping log output.
+"""
+from __future__ import annotations
+
+import collections
+import json
+import threading
+
+EVENT_RING_SIZE = 2048
+
+_ring: collections.deque = collections.deque(maxlen=EVENT_RING_SIZE)
+_lock = threading.Lock()
+
+
+def emit_event(record: dict) -> None:
+    """Emits one structured event as a JSON line (INFO) + rings it."""
+    from ..utils.logging import get_logger
+
+    with _lock:
+        _ring.append(dict(record))
+    get_logger().info(json.dumps(record, sort_keys=True, default=str))
+
+
+def recent_events(n: int | None = None,
+                  event: str | None = None) -> list[dict]:
+    """The last n ringed events (all by default), newest last; ``event``
+    filters on the record's "event" field."""
+    with _lock:
+        out = list(_ring)
+    if event is not None:
+        out = [r for r in out if r.get("event") == event]
+    if n is not None:
+        out = out[-n:]
+    return out
+
+
+def clear_events() -> None:
+    with _lock:
+        _ring.clear()

@@ -1,0 +1,123 @@
+"""Lightweight span tracing: monotonic-clock spans with parent nesting.
+
+A span is a named wall-clock interval around host-side work:
+
+    with span("miner.sweep", height=h):
+        res = backend.search(...)
+
+Spans nest through a thread-local stack (each thread traces its own tree,
+so the GIL-free bench pool cannot corrupt nesting), carry their parent's
+name and depth, and on exit are filed with the default registry: appended
+to the bounded span log and mirrored into the ``span_seconds`` summary
+labeled by span name.
+
+Perfetto bridge (exporter 3): while ``enable_perfetto()`` is active —
+``utils.profiling.trace_mining`` turns it on for the duration of a
+jax.profiler capture — every span additionally enters a
+``jax.profiler.TraceAnnotation``, so our host-side spans nest inside the
+device trace timeline on ui.perfetto.dev. Off by default: the common path
+never imports jax.
+
+Naming convention (docs/observability.md): dotted ``layer.operation``
+lowercase names — ``miner.block``, ``miner.sweep``, ``miner.append``,
+``backend.tpu.dispatch``, ``backend.cpu.search``, ``fused.dispatch``,
+``sim.step``.
+"""
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import threading
+import time
+import warnings
+
+from .registry import Registry, default_registry
+
+_tls = threading.local()
+_perfetto_enabled = False
+
+
+@dataclasses.dataclass
+class Span:
+    name: str
+    parent: str | None = None
+    depth: int = 0
+    attrs: dict = dataclasses.field(default_factory=dict)
+    duration_s: float | None = None
+
+    def to_dict(self) -> dict:
+        return {"name": self.name, "parent": self.parent,
+                "depth": self.depth, "attrs": dict(self.attrs),
+                "duration_s": self.duration_s}
+
+
+def _stack() -> list:
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = _tls.stack = []
+    return stack
+
+
+def active_span() -> Span | None:
+    """The innermost open span on this thread, or None."""
+    stack = _stack()
+    return stack[-1] if stack else None
+
+
+def enable_perfetto() -> bool:
+    """Turns on the jax.profiler.TraceAnnotation bridge for every span.
+
+    Returns False (with a warning) when jax.profiler is unavailable —
+    callers treat that as 'bridge not active', never an error.
+    """
+    global _perfetto_enabled
+    try:
+        import jax
+
+        jax.profiler.TraceAnnotation  # noqa: B018  probe the attribute
+    except Exception as e:  # jax absent or stripped-down build
+        warnings.warn(f"perfetto span bridge unavailable ({e!r}); "
+                      f"spans stay host-side only", RuntimeWarning,
+                      stacklevel=2)
+        return False
+    _perfetto_enabled = True
+    return True
+
+
+def disable_perfetto() -> None:
+    global _perfetto_enabled
+    _perfetto_enabled = False
+
+
+def perfetto_enabled() -> bool:
+    return _perfetto_enabled
+
+
+def _annotation(name: str):
+    if not _perfetto_enabled:
+        return contextlib.nullcontext()
+    try:
+        import jax
+
+        return jax.profiler.TraceAnnotation(name)
+    except Exception:  # raced a disable / jax went away: degrade silently
+        return contextlib.nullcontext()
+
+
+@contextlib.contextmanager
+def span(name: str, registry: Registry | None = None, **attrs):
+    """Context manager timing one named operation (host-side only —
+    chainlint JAX006 forbids this inside jit-traced functions)."""
+    stack = _stack()
+    parent = stack[-1].name if stack else None
+    s = Span(name=name, parent=parent, depth=len(stack), attrs=attrs)
+    stack.append(s)
+    t0 = time.perf_counter()
+    try:
+        with _annotation(name):
+            yield s
+    finally:
+        s.duration_s = time.perf_counter() - t0
+        stack.pop()
+        (registry if registry is not None
+         else default_registry()).record_span(s)

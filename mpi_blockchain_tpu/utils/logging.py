@@ -6,7 +6,6 @@ Python logging so callers can redirect or silence them.
 """
 from __future__ import annotations
 
-import json
 import logging
 from typing import Callable
 
@@ -25,10 +24,14 @@ def get_logger() -> logging.Logger:
 
 
 def block_logger() -> Callable[[dict], None]:
-    """Returns a callable that logs one structured record as a JSON line."""
-    logger = get_logger()
+    """Returns a callable that logs one structured record as a JSON line.
 
-    def log(record: dict) -> None:
-        logger.debug(json.dumps(record, sort_keys=True))
+    Delegates to the telemetry JSON-lines event stream, which logs at
+    INFO — the level ``get_logger()`` actually enables. (The original
+    implementation logged at DEBUG under the INFO logger, silently
+    dropping every per-block record; ``tests/test_telemetry.py`` holds
+    the regression.) Kept as the stable seam Miner/FusedMiner inject.
+    """
+    from ..telemetry.events import emit_event
 
-    return log
+    return emit_event

@@ -6,19 +6,45 @@ trace of the sweep kernels:
     with trace_mining("/tmp/trace"):
         miner.mine_chain(10)
 
-View with ui.perfetto.dev or tensorboard --logdir.
+View with ui.perfetto.dev or tensorboard --logdir. While the capture is
+active, the telemetry span bridge is enabled: every host-side telemetry
+span (miner.sweep, backend.tpu.dispatch, ...) enters a
+``jax.profiler.TraceAnnotation``, so the host timeline nests alongside
+the device kernels in the same trace.
+
+Hardened: the logdir is created if missing, ``create_perfetto_link`` is
+passed through to ``start_trace``, and a missing/stripped jax.profiler
+turns the whole context into a warned no-op instead of an exception —
+profiling must never take down a mining run.
 """
 from __future__ import annotations
 
 import contextlib
+import os
+import warnings
 
 
 @contextlib.contextmanager
-def trace_mining(logdir: str):
-    import jax
+def trace_mining(logdir: str, create_perfetto_link: bool = False):
+    try:
+        import jax
 
-    jax.profiler.start_trace(logdir)
+        profiler = jax.profiler
+        profiler.start_trace  # noqa: B018  probe before committing
+    except (ImportError, AttributeError) as e:
+        warnings.warn(f"jax.profiler unavailable ({e!r}); trace_mining "
+                      f"is a no-op", RuntimeWarning, stacklevel=3)
+        yield
+        return
+
+    from ..telemetry import spans as _spans
+
+    os.makedirs(logdir, exist_ok=True)
+    profiler.start_trace(logdir, create_perfetto_link=create_perfetto_link)
+    bridged = _spans.enable_perfetto()
     try:
         yield
     finally:
-        jax.profiler.stop_trace()
+        if bridged:
+            _spans.disable_perfetto()
+        profiler.stop_trace()

@@ -159,6 +159,7 @@ BAD_JAX = textwrap.dedent("""\
         y = np.cumsum(x)                 # JAX003: numpy in jit
         jax.debug.print("x={}", x)       # JAX002: host callback
         z = x >> 3                       # JAX004: bare literal shift
+        telemetry.counter("hashes").inc()   # JAX006: telemetry in jit
         w = jax.lax.axis_index("colz")   # JAX005: axis in arg slot 0
         return jax.lax.psum(z + y + w, "rows")   # JAX005: bad axis
 
@@ -176,7 +177,8 @@ def test_jax_lint_rules(tmp_path):
     bad.write_text(BAD_JAX)
     findings = run_jax_lint(ROOT, overrides={"jax_files": [bad]})
     rules = rule_set(findings)
-    assert rules == {"JAX001", "JAX002", "JAX003", "JAX004", "JAX005"}
+    assert rules == {"JAX001", "JAX002", "JAX003", "JAX004", "JAX005",
+                     "JAX006"}
     # The static-argnames branch in g() must NOT fire JAX001.
     assert all("'g'" not in f.message for f in findings)
 

@@ -1,0 +1,402 @@
+"""Telemetry subsystem tests (mpi_blockchain_tpu/telemetry).
+
+Covers the registry semantics (counter monotonicity, metric identity,
+histogram quantiles + bounded reservoir, thread-safety under the GIL-free
+bench_cpu pool), span nesting, the three exporters (JSON-lines events,
+Prometheus snapshot golden output, perfetto bridge enablement), the
+block_logger INFO regression, trace_mining hardening, and the smoke CLI
+— the ISSUE acceptance criteria as executable assertions.
+"""
+import json
+import logging
+import pathlib
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from mpi_blockchain_tpu import telemetry
+from mpi_blockchain_tpu.telemetry import MetricError, Registry
+from mpi_blockchain_tpu.telemetry.spans import active_span, span
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(autouse=True)
+def fresh_registry():
+    """Every test sees a pristine default registry and event ring."""
+    telemetry.reset()
+    telemetry.clear_events()
+    yield
+    telemetry.reset()
+    telemetry.clear_events()
+
+
+# ---- registry semantics ------------------------------------------------
+
+
+def test_counter_monotonic():
+    c = telemetry.counter("t_total", help="h", backend="cpu")
+    c.inc()
+    c.inc(5)
+    assert c.value == 6
+    with pytest.raises(MetricError):
+        c.inc(-1)
+    assert c.value == 6
+
+
+def test_metric_identity_and_kind_conflict():
+    a = telemetry.counter("same", backend="cpu")
+    b = telemetry.counter("same", backend="cpu")
+    assert a is b
+    other = telemetry.counter("same", backend="tpu")
+    assert other is not a          # different labels, different series
+    with pytest.raises(MetricError, match="already registered"):
+        telemetry.gauge("same", backend="cpu")
+
+
+def test_gauge_set_inc_dec():
+    g = telemetry.gauge("g")
+    g.set(10)
+    g.inc(2.5)
+    g.dec()
+    assert g.value == 11.5
+
+
+def test_histogram_quantiles_and_bounded_reservoir():
+    r = Registry()
+    h = r.histogram("lat_ms")
+    for v in range(1, 5001):
+        h.observe(float(v))
+    assert h.count == 5000
+    assert h.sum == sum(range(1, 5001))
+    snap = h.snapshot()
+    assert snap["min"] == 1.0 and snap["max"] == 5000.0
+    # Reservoir-sampled quantiles: loose but meaningful bounds.
+    assert 2000 < snap["p50"] < 3000
+    assert 4000 < snap["p90"] <= 5000
+    # The reservoir is bounded even though count is exact.
+    assert len(h._reservoir) == h.RESERVOIR_SIZE
+    with pytest.raises(MetricError):
+        h.quantile(1.5)
+
+
+def test_histogram_reservoir_deterministic():
+    """Same name + same observations => identical quantiles (the crc32
+    seed pins the reservoir RNG; no global RNG state involved)."""
+    def build():
+        h = Registry().histogram("same_h")
+        for v in range(10_000):
+            h.observe(float(v % 997))
+        return h.snapshot()
+
+    assert build() == build()
+
+
+def test_counter_thread_safety():
+    c = telemetry.counter("hammer_total")
+
+    def hit():
+        for _ in range(20_000):
+            c.inc()
+
+    threads = [threading.Thread(target=hit) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == 8 * 20_000
+
+
+def test_bench_cpu_counter_matches_result():
+    """The GIL-free bench rank pool increments the shared counter from
+    real worker threads; the registry total must match the summed
+    per-rank return values exactly."""
+    from mpi_blockchain_tpu.bench_lib import bench_cpu
+
+    result = bench_cpu(seconds=0.2, n_miners=2, chunk=1 << 14)
+    assert result["hashes"] > 0
+    assert telemetry.counter("bench_hashes_total",
+                             backend="cpu").value == result["hashes"]
+    assert telemetry.gauge("bench_hashes_per_sec",
+                           backend="cpu").value > 0
+
+
+# ---- spans -------------------------------------------------------------
+
+
+def test_span_nesting_and_recording():
+    with span("outer", kind="test") as outer:
+        assert active_span() is outer
+        assert outer.parent is None and outer.depth == 0
+        with span("inner") as inner:
+            assert inner.parent == "outer" and inner.depth == 1
+        assert active_span() is outer
+    assert active_span() is None
+    recorded = telemetry.default_registry().spans()
+    assert [s.name for s in recorded] == ["inner", "outer"]  # finish order
+    assert all(s.duration_s is not None and s.duration_s >= 0
+               for s in recorded)
+    assert outer.attrs == {"kind": "test"}
+    # Mirrored into the span_seconds summary, labeled by span name.
+    assert telemetry.default_registry().histogram(
+        "span_seconds", span="outer").count == 1
+
+
+def test_span_thread_isolation():
+    """Each thread traces its own stack: a span opened on a worker thread
+    must not see the main thread's open span as its parent."""
+    seen = {}
+
+    def worker():
+        with span("worker.op") as s:
+            seen["parent"] = s.parent
+            seen["depth"] = s.depth
+
+    with span("main.op"):
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+    assert seen == {"parent": None, "depth": 0}
+
+
+# ---- exporters ---------------------------------------------------------
+
+
+def test_render_prometheus_golden():
+    r = Registry()
+    r.counter("c_total", help="a counter", backend="cpu").inc(3)
+    r.gauge("g").set(2.5)
+    h = r.histogram("h_ms")
+    for v in (1.0, 2.0, 3.0, 4.0):
+        h.observe(v)
+    expected = (
+        "# HELP c_total a counter\n"
+        "# TYPE c_total counter\n"
+        'c_total{backend="cpu"} 3\n'
+        "# TYPE g gauge\n"
+        "g 2.5\n"
+        "# TYPE h_ms summary\n"
+        'h_ms{quantile="0.5"} 3\n'
+        'h_ms{quantile="0.9"} 4\n'
+        'h_ms{quantile="0.99"} 4\n'
+        "h_ms_count 4\n"
+        "h_ms_sum 10\n")
+    assert r.render_prometheus() == expected
+
+
+def test_render_prometheus_escapes_label_values():
+    r = Registry()
+    r.counter("esc_total", reason='bad "value"\nwith\\stuff').inc()
+    assert ('esc_total{reason="bad \\"value\\"\\nwith\\\\stuff"} 1'
+            in r.render_prometheus())
+
+
+def test_snapshot_is_json_serializable():
+    telemetry.counter("a_total", backend="cpu").inc(2)
+    telemetry.histogram("b_ms").observe(1.5)
+    snap = telemetry.default_registry().snapshot()
+    parsed = json.loads(json.dumps(snap))
+    assert parsed["a_total"][0]["value"] == 2
+    assert parsed["b_ms"][0]["count"] == 1
+
+
+def test_emit_event_rings_and_logs_at_info():
+    from mpi_blockchain_tpu.utils.logging import get_logger
+
+    logger = get_logger()
+    capture = []
+
+    class Handler(logging.Handler):
+        def emit(self, record):
+            capture.append(record)
+
+    h = Handler()
+    logger.addHandler(h)
+    try:
+        telemetry.emit_event({"event": "unit_test", "n": 1})
+    finally:
+        logger.removeHandler(h)
+    assert telemetry.recent_events(event="unit_test") == [
+        {"event": "unit_test", "n": 1}]
+    assert len(capture) == 1
+    assert capture[0].levelno == logging.INFO
+    assert json.loads(capture[0].getMessage()) == {"event": "unit_test",
+                                                   "n": 1}
+
+
+def test_block_logger_emits_at_default_level(caplog):
+    """Regression: block_logger logged at DEBUG under the INFO logger, so
+    every per-block JSON record was silently dropped. It must emit at
+    INFO — visible at the logger's default level."""
+    from mpi_blockchain_tpu.utils.logging import block_logger, get_logger
+
+    logger = get_logger()
+    assert logger.getEffectiveLevel() == logging.INFO
+    logger.addHandler(caplog.handler)
+    try:
+        block_logger()({"event": "block_mined", "height": 1})
+    finally:
+        logger.removeHandler(caplog.handler)
+    records = [r for r in caplog.records if "block_mined" in r.getMessage()]
+    assert records, "per-block record was dropped at default log level"
+    assert records[0].levelno == logging.INFO
+    assert json.loads(records[0].getMessage())["height"] == 1
+
+
+def test_perfetto_bridge_via_trace_mining(tmp_path):
+    """trace_mining enables the TraceAnnotation bridge for its duration
+    and creates a missing (nested) logdir."""
+    from mpi_blockchain_tpu.telemetry.spans import perfetto_enabled
+    from mpi_blockchain_tpu.utils.profiling import trace_mining
+
+    logdir = tmp_path / "missing" / "nested"
+    assert not perfetto_enabled()
+    with trace_mining(str(logdir)):
+        assert perfetto_enabled()
+        with span("bridge.test"):
+            pass
+    assert not perfetto_enabled()
+    assert logdir.is_dir()
+
+
+def test_trace_mining_noop_without_profiler(monkeypatch):
+    import jax
+
+    from mpi_blockchain_tpu.utils.profiling import trace_mining
+
+    monkeypatch.delattr(jax, "profiler")
+    with pytest.warns(RuntimeWarning, match="no-op"):
+        with trace_mining("/nonexistent/should/not/be/created"):
+            pass
+    assert not pathlib.Path("/nonexistent/should/not/be/created").exists()
+
+
+def test_trace_mining_passes_create_perfetto_link(tmp_path, monkeypatch):
+    import contextlib
+
+    import jax
+
+    calls = {}
+
+    class FakeProfiler:
+        @staticmethod
+        def start_trace(logdir, create_perfetto_link=False):
+            calls["start"] = (logdir, create_perfetto_link)
+
+        @staticmethod
+        def stop_trace():
+            calls["stop"] = True
+
+        @staticmethod
+        def TraceAnnotation(name):
+            return contextlib.nullcontext()
+
+    monkeypatch.setattr(jax, "profiler", FakeProfiler)
+    from mpi_blockchain_tpu.utils.profiling import trace_mining
+
+    logdir = tmp_path / "t"
+    with trace_mining(str(logdir), create_perfetto_link=True):
+        pass
+    assert calls["start"] == (str(logdir), True)
+    assert calls.get("stop") is True
+    assert logdir.is_dir()
+
+
+# ---- full-stack wiring -------------------------------------------------
+
+
+def test_miner_metrics_end_to_end():
+    from mpi_blockchain_tpu.config import MinerConfig
+    from mpi_blockchain_tpu.models.miner import Miner
+
+    miner = Miner(MinerConfig(difficulty_bits=8, n_blocks=2, backend="cpu"))
+    miner.mine_chain()
+    reg = telemetry.default_registry()
+    assert telemetry.counter("blocks_mined_total", backend="cpu").value == 2
+    assert telemetry.counter("mining_rounds_total", backend="cpu").value >= 2
+    assert telemetry.counter(
+        "hashes_tried_total", backend="cpu").value == miner.total_hashes()
+    assert telemetry.histogram("block_latency_ms", backend="cpu").count == 2
+    assert len(reg.spans("miner.block")) == 2
+    assert len(reg.spans("backend.cpu.search")) >= 2
+    # Per-block records reached the JSON-lines stream.
+    assert len(telemetry.recent_events(event="block_mined")) == 2
+
+
+def test_simulation_fault_metrics():
+    """ISSUE acceptance: a faulted sim run shows non-zero drop and reorg
+    metrics, and the GroupStats gauges mirror the final stats."""
+    from mpi_blockchain_tpu.simulation import run_adversarial
+
+    net = run_adversarial(partition_steps=12, target_height=4,
+                          nonce_budget=1 << 8, drop_rate_pct=25, seed=0)
+    assert telemetry.counter("sim_messages_sent_total").value > 0
+    assert telemetry.counter("sim_messages_dropped_total").value > 0
+    assert telemetry.counter("sim_reorgs_total").value > 0
+    assert telemetry.histogram("sim_reorg_depth").count > 0
+    for node in net.nodes:
+        g = str(node.id)
+        assert telemetry.gauge("sim_group_height",
+                               group=g).value == node.node.height
+        assert telemetry.gauge("sim_group_blocks_mined",
+                               group=g).value == node.stats.blocks_mined
+
+
+def test_telemetry_cli_in_process(tmp_path, capsys):
+    from mpi_blockchain_tpu.telemetry.__main__ import main
+
+    dump = tmp_path / "snap.prom"
+    rc = main(["--steps", "2", "--metrics-dump", str(dump)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    for needle in ("mining_rounds_total", "hashes_tried_total",
+                   "block_latency_ms_count", "sim_reorg_depth_count"):
+        assert needle in out, f"snapshot missing {needle}"
+    assert "mining_rounds_total" in dump.read_text()
+    # Faults were injected: drop/reorg metrics are live.
+    assert telemetry.counter("sim_messages_dropped_total").value > 0
+    assert telemetry.counter("sim_reorgs_total").value > 0
+
+
+def test_telemetry_cli_subprocess_acceptance():
+    """The literal acceptance command: exits 0 and emits the headline
+    counters + at least one histogram in Prometheus text format."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "mpi_blockchain_tpu.telemetry",
+         "--steps", "3"],
+        cwd=ROOT, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "mining_rounds_total" in proc.stdout
+    assert "hashes_tried_total" in proc.stdout
+    assert "_count" in proc.stdout          # at least one histogram/summary
+    assert "# TYPE" in proc.stdout
+
+
+def test_cli_metrics_dump_flag(tmp_path, capsys):
+    from mpi_blockchain_tpu.cli import main
+
+    dump = tmp_path / "mine.prom"
+    rc = main(["mine", "--difficulty", "8", "--blocks", "2",
+               "--backend", "cpu", "--metrics-dump", str(dump)])
+    assert rc == 0
+    capsys.readouterr()
+    text = dump.read_text()
+    assert "hashes_tried_total" in text
+    assert "blocks_mined_total" in text
+
+
+def test_cli_metrics_dump_written_on_failure(tmp_path, capsys):
+    """Post-mortem contract: the dump is written on every exit path,
+    config errors included."""
+    from mpi_blockchain_tpu.cli import main
+
+    telemetry.gauge("leftover").set(1)      # something to snapshot
+    dump = tmp_path / "fail.prom"
+    rc = main(["mine", "--difficulty", "8", "--blocks", "1",
+               "--backend", "tpu", "--miners", "9999",
+               "--metrics-dump", str(dump)])
+    assert rc == 2                          # ConfigError path
+    capsys.readouterr()
+    assert "leftover" in dump.read_text()
