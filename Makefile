@@ -4,9 +4,9 @@
 PY ?= python3
 SHELL := /bin/bash   # tier1 uses pipefail/PIPESTATUS
 
-.PHONY: check lint metrics-smoke tier1 core clean
+.PHONY: check lint metrics-smoke forensics-smoke tier1 core clean
 
-check: lint metrics-smoke tier1
+check: lint metrics-smoke forensics-smoke tier1
 
 # chainlint: binding contract, header layout, JAX purity, sanitizer matrix.
 lint:
@@ -23,6 +23,34 @@ metrics-smoke:
 	echo "$$out" | grep -q '_count' || \
 	    { echo "metrics-smoke: required metrics missing"; exit 1; }; \
 	echo "metrics-smoke: ok ($$(echo "$$out" | wc -l) snapshot lines)"
+
+# Forensics smoke: a seeded 3-group faulted sim must dump causal logs,
+# and the forensics CLI must reconstruct a non-empty fork tree with at
+# least one trace event per node from them.
+forensics-smoke:
+	tmp=$$(mktemp -d); \
+	env JAX_PLATFORMS=cpu $(PY) -m mpi_blockchain_tpu sim --groups 3 \
+	    --drop-rate 20 --seed 3 --blocks 4 --partition-steps 12 \
+	    --events-dump $$tmp/causal.json >/dev/null 2>&1 || \
+	    { echo "forensics-smoke: faulted sim failed"; rm -rf $$tmp; exit 1; }; \
+	env JAX_PLATFORMS=cpu $(PY) -m mpi_blockchain_tpu.forensics \
+	    --events $$tmp/causal.json --trace $$tmp/trace.json --json \
+	    > $$tmp/report.json 2>/dev/null || \
+	    { echo "forensics-smoke: forensics CLI failed"; rm -rf $$tmp; exit 1; }; \
+	$(PY) -c "import json,sys; \
+	r = json.load(open('$$tmp/report.json')); \
+	t = json.load(open('$$tmp/trace.json')); \
+	assert r['fork_tree']['blocks'], 'empty fork tree'; \
+	assert r['fork_tree']['fork_points'], 'no fork reconstructed'; \
+	assert r['convergence']['reorgs'] >= 1, 'no reorg audited'; \
+	pids = {e['pid'] for e in t['traceEvents'] if e['ph'] == 'X'}; \
+	assert len(pids) >= 4, f'trace rows missing: {sorted(pids)}'; \
+	print('forensics-smoke: ok (%d blocks, %d fork points, %d reorgs, ' \
+	      '%d trace events)' % (len(r['fork_tree']['blocks']), \
+	      len(r['fork_tree']['fork_points']), r['convergence']['reorgs'], \
+	      len(t['traceEvents'])))" || \
+	    { echo "forensics-smoke: assertions failed"; rm -rf $$tmp; exit 1; }; \
+	rm -rf $$tmp
 
 # Tier-1 verify, verbatim from ROADMAP.md.
 tier1:

@@ -51,17 +51,32 @@ CACHE_PATH = REPO / "BENCH_CACHE.json"
 # load-varying 0.8-1.8 MH/s drifted the old headline) is demoted to detail.
 CANONICAL_CPU_NP8_HS = 1.78e6
 
-# Marker string present in every device-child cmdline so a stale-process
-# sweep can find leftovers from earlier runs: MBT_BENCH_SECTION.
-_DEVICE_CODE = """
-# MBT_BENCH_SECTION device child
-import json
+# Shared child preamble: the BENCH_JSON emitter + attributable-init phase
+# streaming. Each phase is streamed BEFORE it runs, so when the parent
+# watchdog fires, the last device_init section names the phase that hung
+# (the round-1 "device init hang?" guesswork, made structured).
+_CHILD_PRELUDE = """
+import json, time
 def emit(section, payload):
     print("BENCH_JSON:" + json.dumps({"section": section,
                                       "payload": payload}), flush=True)
+_t0 = time.monotonic()
+def phase(name, status):
+    emit("device_init", {"phase": name, "status": status,
+                         "elapsed_s": round(time.monotonic() - _t0, 1)})
+"""
+
+# Marker string present in every device-child cmdline so a stale-process
+# sweep can find leftovers from earlier runs: MBT_BENCH_SECTION.
+_DEVICE_CODE = _CHILD_PRELUDE + """
+# MBT_BENCH_SECTION device child
+phase("jax_import", "start")
 import jax
 from mpi_blockchain_tpu.bench_lib import bench_chain, bench_tpu, repeat_best
+phase("jax_import", "done")
+phase("backend_resolve", "start")
 emit("platform", jax.default_backend())
+phase("backend_resolve", "done")
 # Official sections are best-of-2 with the spread on the record
 # (BASELINE.md's tunnel warning: a single run can be inflated >10x).
 # Rep 1 is STREAMED before the later reps run: the parent keeps the last
@@ -69,6 +84,10 @@ emit("platform", jax.default_backend())
 # rep discipline, never the completed measurement.
 def sweep_once():
     return bench_tpu(seconds=6.0, batch_pow2=28, n_miners=1, kernel="auto")
+# The sweep's own kernel_build/compile_warm init runs inside bench_tpu;
+# streaming a phase marker around each section means a hang ANYWHERE is
+# attributed to the section in flight, not to the last init phase done.
+phase("sweep", "start")
 try:
     first = sweep_once()
     emit("sweep", first)
@@ -77,6 +96,7 @@ try:
                               prior=[first]))
 except Exception as e:
     emit("sweep_error", f"{type(e).__name__}: {e}")
+phase("sweep", "done")
 # Second half of the metric: wall-clock to mine 1000 blocks at difficulty
 # 24 (real accelerator only -- the host-CPU fallback would take hours).
 # blocks_per_call=500 from the round-4 hardware sweep: 18.6-18.7 s vs
@@ -86,6 +106,7 @@ if jax.default_backend() != "cpu":
     def chain_once():
         return bench_chain(n_blocks=1000, difficulty_bits=24,
                            blocks_per_call=500)
+    phase("chain", "start")
     try:
         first = chain_once()
         emit("chain", first)
@@ -93,29 +114,36 @@ if jax.default_backend() != "cpu":
                                   minimize=True, prior=[first]))
     except Exception as e:
         emit("chain_error", f"{type(e).__name__}: {e}")
+    phase("chain", "done")
     # Config 4's exact production combination on hardware: shard_map +
     # Pallas + psum/pmin on a 1-device ('miners',) mesh, tip checked
     # against the C++ oracle (single measurement source in bench_lib).
+    phase("sharded_pallas", "start")
     try:
         from mpi_blockchain_tpu.bench_lib import bench_sharded_pallas
         emit("sharded_pallas", bench_sharded_pallas())
     except Exception as e:
         emit("sharded_pallas_error", f"{type(e).__name__}: {e}")
+    phase("sharded_pallas", "done")
     # Config 3's literal preset through the round-4 multi-round searcher
     # (the dispatch-latency regression record; was 2.83 MH/s in round 1).
+    phase("tpu_single", "start")
     try:
         from mpi_blockchain_tpu.bench_lib import bench_tpu_single
         emit("tpu_single", bench_tpu_single())
     except Exception as e:
         emit("tpu_single_error", f"{type(e).__name__}: {e}")
+    phase("tpu_single", "done")
 """
 
-_PROBE_CODE = """
+_PROBE_CODE = _CHILD_PRELUDE + """
 # MBT_BENCH_SECTION probe child
-import json, jax
-print("BENCH_JSON:" + json.dumps({"section": "platform",
-                                  "payload": jax.default_backend()}),
-      flush=True)
+phase("jax_import", "start")
+import jax
+phase("jax_import", "done")
+phase("backend_resolve", "start")
+emit("platform", jax.default_backend())
+phase("backend_resolve", "done")
 """
 
 # Utilization at the measured rate (experiments/roofline.py: traced op
@@ -217,7 +245,15 @@ def _stream_child(code: str, timeout_s: float,
     except subprocess.TimeoutExpired:
         proc.kill()
         proc.wait()
-        error = (f"child timed out after {timeout_s:.0f}s; "
+        # The last streamed phase marker names what was in flight when
+        # the watchdog fired — no more "init hang?" guess. status
+        # "start" means the hang is INSIDE that phase/section; "done"
+        # means it struck between markers.
+        last_phase = sections.get("device_init")
+        where = (f" (last streamed phase: {last_phase['phase']!r} "
+                 f"{last_phase['status']} at {last_phase['elapsed_s']}s)"
+                 if isinstance(last_phase, dict) else "")
+        error = (f"child timed out after {timeout_s:.0f}s{where}; "
                  f"stderr tail: {''.join(err_tail)[-500:]}")
     return sections, error
 

@@ -17,6 +17,10 @@ classic *drift* bugs at analysis time, before any run launches:
 * ``sanitizers`` — the tsan/asan/ubsan Makefile matrix plus the
   cppcheck/clang-tidy ``analyze`` target, surfaced as SAN0xx rules (tools
   gracefully skip when not installed).
+* ``telemetry_lint`` — causal-stamp discipline on the simulation bus:
+  sim-bus events must carry ``lamport``/``node`` (i.e. go through
+  ``CausalLog.record``), or the forensics merge cannot place them
+  (TEL0xx rules).
 
 CLI: ``python -m mpi_blockchain_tpu.analysis`` — exits non-zero on any
 finding. Inline suppression: a ``chainlint: disable=RULE`` comment on the
@@ -105,11 +109,13 @@ def pass_families() -> dict[str, Callable[..., list[Finding]]]:
     from .header_layout import run_header_layout
     from .jax_lint import run_jax_lint
     from .sanitizers import run_sanitizers
+    from .telemetry_lint import run_telemetry_lint
     return {
         "binding": run_binding_contract,
         "header": run_header_layout,
         "jax": run_jax_lint,
         "sanitizers": run_sanitizers,
+        "telemetry": run_telemetry_lint,
     }
 
 

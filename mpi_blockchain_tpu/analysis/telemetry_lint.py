@@ -1,0 +1,83 @@
+"""TEL rules — causal-stamp discipline on the simulation bus.
+
+The forensics subsystem can only merge per-node logs into one causal
+order if every sim-bus event carries a Lamport stamp and a node id.
+``CausalLog.record`` stamps both automatically; the classic drift bug is
+a future edit that emits a bus event through the raw JSON-lines stream
+(``emit_event``) instead, producing records the merge cannot place.
+
+  TEL001  ``emit_event(...)`` in a simulation-bus module whose payload
+          cannot be proven to carry both ``lamport`` and ``node`` fields
+          — either the dict literal omits them, or the payload is not a
+          literal at all. Route sim-bus events through
+          ``CausalLog.record`` (telemetry/causal.py), which stamps both.
+
+Scope: ``mpi_blockchain_tpu/simulation.py`` (the bus surface). Override
+key ``sim_py`` redirects it — the drift-fixture test seam.
+"""
+from __future__ import annotations
+
+import ast
+import pathlib
+
+from . import Finding
+from .jax_lint import _call_name
+
+REQUIRED_FIELDS = ("lamport", "node")
+
+
+def _literal_str_keys(node: ast.expr) -> set[str] | None:
+    """Keys of a dict literal (or dict(...) call with kwargs); None when
+    the payload is not statically analyzable."""
+    if isinstance(node, ast.Dict):
+        keys = set()
+        for k in node.keys:
+            if k is None:  # **spread: keys unknowable
+                return None
+            if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                keys.add(k.value)
+        return keys
+    if (isinstance(node, ast.Call) and _call_name(node) == "dict"
+            and not node.args):
+        if any(kw.arg is None for kw in node.keywords):
+            return None
+        return {kw.arg for kw in node.keywords}
+    return None
+
+
+def run_telemetry_lint(root: pathlib.Path, overrides=None,
+                       notes=None) -> list[Finding]:
+    overrides = overrides or {}
+    sim_py = overrides.get(
+        "sim_py", root / "mpi_blockchain_tpu" / "simulation.py")
+    findings: list[Finding] = []
+    rel = (str(sim_py.relative_to(root)) if sim_py.is_relative_to(root)
+           else str(sim_py))
+    try:
+        tree = ast.parse(sim_py.read_text(), filename=str(sim_py))
+    except SyntaxError as e:
+        return [Finding(rel, e.lineno or 1, "TEL000",
+                        f"syntax error: {e.msg}")]
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or \
+                _call_name(node) != "emit_event":
+            continue
+        payload = node.args[0] if node.args else None
+        keys = _literal_str_keys(payload) if payload is not None else set()
+        if keys is None:
+            findings.append(Finding(
+                rel, node.lineno, "TEL001",
+                "emit_event on the simulation bus with a non-literal "
+                "payload — the causal stamp cannot be verified; route "
+                "the event through CausalLog.record, which stamps "
+                "lamport/node automatically"))
+        else:
+            missing = [f for f in REQUIRED_FIELDS if f not in keys]
+            if missing:
+                findings.append(Finding(
+                    rel, node.lineno, "TEL001",
+                    f"sim-bus event omits causal field(s) "
+                    f"{missing} — the forensics merge cannot place it; "
+                    f"use CausalLog.record (stamps lamport/node) instead "
+                    f"of raw emit_event"))
+    return findings

@@ -9,13 +9,69 @@ BASELINE.md as the "mpirun -np N" stand-in since OpenMPI is not in the image.
 from __future__ import annotations
 
 import concurrent.futures
+import contextlib
+import threading
 import time
 
 from . import core
-from .telemetry import counter, gauge
+from .telemetry import counter, emit_event, gauge
+from .telemetry.events import env_number
+from .telemetry.spans import span
 
 _IMPOSSIBLE_DIFFICULTY = 64  # no 64-leading-zero-bit hash will be found
 _HEADER = bytes(range(80))   # arbitrary fixed header; content is irrelevant
+
+# Per-phase device-init watchdog budget. The round-1 failure mode was a
+# 900 s parent timeout with zero attribution ("device init hang?"); now
+# each init phase is a structured bench.device_init span/event, and a
+# phase exceeding this budget emits a hang event + flight-recorder dump
+# BEFORE any parent watchdog kills the process.
+DEVICE_INIT_PHASE_TIMEOUT_S = env_number(
+    "MPIBT_DEVICE_INIT_TIMEOUT", 300.0, cast=float, minimum=1e-6)
+
+
+@contextlib.contextmanager
+def _device_init_phase(name: str, timeout_s: float | None = None):
+    """One attributable device-init phase: a ``bench.device_init`` span,
+    a completion event carrying (phase, elapsed_s), and a hang watchdog.
+
+    The watchdog thread fires while the process is still alive, so the
+    hang event and the flight-recorder artifact exist even when a parent
+    harness (bench.py) subsequently SIGKILLs the wedged child — the dump
+    is what makes "timed out after 900s" attributable to a phase.
+    """
+    from .telemetry import flight_recorder
+
+    timeout_s = (DEVICE_INIT_PHASE_TIMEOUT_S if timeout_s is None
+                 else timeout_s)
+    t0 = time.perf_counter()
+
+    def _hang() -> None:
+        elapsed = round(time.perf_counter() - t0, 1)
+        emit_event({"event": "bench.device_init", "phase": name,
+                    "status": "hang", "elapsed_s": elapsed,
+                    "timeout_s": timeout_s})
+        flight_recorder.dump_now(
+            f"bench.device_init hang: phase {name!r} still running "
+            f"after {elapsed}s (budget {timeout_s}s)")
+
+    watchdog = threading.Timer(timeout_s, _hang)
+    watchdog.daemon = True
+    watchdog.start()
+    status = "done"
+    try:
+        with span("bench.device_init", phase=name):
+            yield
+    except BaseException as e:
+        # The phase that RAISED must not read as 'done' in a crash dump
+        # — the phase label is the attribution this machinery exists for.
+        status = f"error: {type(e).__name__}"
+        raise
+    finally:
+        watchdog.cancel()
+        emit_event({"event": "bench.device_init", "phase": name,
+                    "status": status,
+                    "elapsed_s": round(time.perf_counter() - t0, 3)})
 
 
 def bench_cpu(seconds: float = 3.0, n_miners: int = 1,
@@ -65,27 +121,36 @@ def bench_tpu(seconds: float = 5.0, batch_pow2: int = 28,
     axon tunnel) swamps the kernel below ~2^26 nonces/dispatch, and the
     VPU-saturated plateau starts there (see ops/sha256_pallas.py).
     """
-    import jax
-    import numpy as np
+    with _device_init_phase("jax_import"):
+        import jax
+        import numpy as np
 
-    if jax.default_backend() == "cpu":
+    with _device_init_phase("backend_resolve"):
+        # The first real device-init trigger: under the axon tunnel THIS
+        # is where a wedged init historically hung for the full 900 s.
+        platform = jax.default_backend()
+
+    if platform == "cpu":
         # The big-batch default exists to beat dispatch overhead on a real
         # accelerator; on host CPU a 2^28 sweep holds a ~GiB-scale live
         # scan carry and can OOM, so clamp to a size the fallback survives.
         batch_pow2 = min(batch_pow2, 22)
     batch = 1 << batch_pow2
     midstate, tail = core.header_midstate(_HEADER)
-    if n_miners > 1:
-        from .parallel.mesh import make_mesh_sweep_fn, make_miner_mesh
-        mesh = make_miner_mesh(n_miners)
-        fn = make_mesh_sweep_fn(mesh, batch, _IMPOSSIBLE_DIFFICULTY, kernel)
-        round_size = batch * n_miners
-    else:
-        from .ops import select_kernel
-        fn, kernel = select_kernel(kernel, batch, _IMPOSSIBLE_DIFFICULTY)
-        round_size = batch
+    with _device_init_phase("kernel_build"):
+        if n_miners > 1:
+            from .parallel.mesh import make_mesh_sweep_fn, make_miner_mesh
+            mesh = make_miner_mesh(n_miners)
+            fn = make_mesh_sweep_fn(mesh, batch, _IMPOSSIBLE_DIFFICULTY,
+                                    kernel)
+            round_size = batch * n_miners
+        else:
+            from .ops import select_kernel
+            fn, kernel = select_kernel(kernel, batch, _IMPOSSIBLE_DIFFICULTY)
+            round_size = batch
 
-    int(fn(midstate, tail, np.uint32(0))[0])  # compile + warm
+    with _device_init_phase("compile_warm"):
+        int(fn(midstate, tail, np.uint32(0))[0])  # compile + warm
     # Pipelined measurement: dispatches are async, so keep a bounded window
     # of in-flight rounds and force completion by materializing the oldest
     # result's VALUE (int(...)). A sync per call would bill one host<->device

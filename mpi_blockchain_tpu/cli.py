@@ -13,6 +13,7 @@ from __future__ import annotations
 import argparse
 import dataclasses
 import json
+import os
 import sys
 import time
 
@@ -49,6 +50,11 @@ def _add_metrics_dump_arg(p: argparse.ArgumentParser) -> None:
     p.add_argument("--metrics-dump", metavar="PATH", default=None,
                    help="write a Prometheus text snapshot of the run's "
                         "telemetry registry to PATH on exit")
+    p.add_argument("--flight-recorder", metavar="PATH", default=None,
+                   help="arm the crash flight recorder: on abnormal exit "
+                        "(uncaught exception, sim non-convergence) dump "
+                        "events + causal logs + metrics snapshot to PATH "
+                        "(env MPIBT_FLIGHT_RECORDER also arms it)")
 
 
 def _config_from(args) -> MinerConfig:
@@ -191,6 +197,7 @@ def cmd_verify(args) -> int:
 def cmd_sim(args) -> int:
     """BASELINE config 5 from the command line: adversarial partition+reorg."""
     from .simulation import run_adversarial
+    from .telemetry import flight_recorder
 
     if args.preset:
         cfg = PRESETS[args.preset]
@@ -201,6 +208,32 @@ def cmd_sim(args) -> int:
             n_blocks=args.blocks, backend=args.backend,
             kernel=args.kernel, batch_pow2=args.batch_pow2)
         target_height = args.blocks
+
+    held: dict = {}
+
+    def _on_network(net) -> None:
+        # Before the run starts: a non-converging run raises out of
+        # run_adversarial, and the causal logs of the FAILED run are
+        # exactly what --events-dump / the flight recorder must capture.
+        held["net"] = net
+        if flight_recorder.installed():
+            flight_recorder.register_network(net)
+
+    def _dump_events() -> None:
+        # Like --metrics-dump: a dump failure must not mask the run's
+        # own outcome (the sim result line + exit code still stand).
+        if args.events_dump and "net" in held:
+            try:
+                held["net"].dump_causal(args.events_dump, meta={
+                    "seed": args.seed, "groups": args.groups,
+                    "partition_steps": args.partition_steps,
+                    "drop_rate_pct": args.drop_rate,
+                    "delay_steps": args.delay_steps,
+                    "target_height": target_height,
+                    "difficulty_bits": cfg.difficulty_bits})
+            except OSError as e:
+                print(f"events-dump failed: {e}", file=sys.stderr)
+
     try:
         net = run_adversarial(config=cfg,
                               partition_steps=args.partition_steps,
@@ -208,11 +241,24 @@ def cmd_sim(args) -> int:
                               nonce_budget=1 << args.nonce_budget_pow2,
                               delay_steps=args.delay_steps,
                               drop_rate_pct=args.drop_rate,
-                              seed=args.seed, n_groups=args.groups)
+                              seed=args.seed, n_groups=args.groups,
+                              on_network=_on_network)
     except RuntimeError as e:  # Network.run: no convergence in max_steps
+        if not hasattr(e, "network"):
+            # Only Network.run's non-convergence error carries .network;
+            # any other RuntimeError (backend/JAX infrastructure failure)
+            # must keep its traceback — and reach the excepthook dump —
+            # not be misreported as a consensus outcome.
+            raise
+        # A fault-injection run that never converges is the flight
+        # recorder's home turf: dump now (the artifact must exist even
+        # though this is a handled rc=1 exit, not a crash).
+        flight_recorder.dump_now(f"sim non-convergence: {e}")
+        _dump_events()
         print(json.dumps({"event": "sim_done", "converged": False,
                           "error": str(e)}, sort_keys=True))
         return 1
+    _dump_events()
     tips = {n.node.tip_hash.hex() for n in net.nodes}
     out = {
         "event": "sim_done",
@@ -352,6 +398,10 @@ def main(argv: list[str] | None = None) -> int:
                        help="seed for the drop schedule")
     p_sim.add_argument("--groups", type=int, default=2,
                        help="number of competing miner groups")
+    p_sim.add_argument("--events-dump", metavar="PATH", default=None,
+                       help="write every node's Lamport-stamped causal "
+                            "event log to PATH on exit (read with "
+                            "python -m mpi_blockchain_tpu.forensics)")
     _add_metrics_dump_arg(p_sim)
     p_sim.set_defaults(fn=cmd_sim)
 
@@ -360,6 +410,12 @@ def main(argv: list[str] | None = None) -> int:
     p_info.set_defaults(fn=cmd_info)
 
     args = parser.parse_args(argv)
+    fr_path = (getattr(args, "flight_recorder", None)
+               or os.environ.get("MPIBT_FLIGHT_RECORDER"))
+    if fr_path:
+        from .telemetry import flight_recorder
+        flight_recorder.install(fr_path)
+        flight_recorder.register_context(command=args.command)
     try:
         return args.fn(args)
     except ConfigError as e:

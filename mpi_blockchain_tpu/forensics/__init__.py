@@ -1,0 +1,52 @@
+"""Chain forensics: merge per-node causal logs, reconstruct what happened.
+
+The simulation layer emits per-node Lamport-stamped event logs
+(``telemetry/causal.py``); this package is the *reader* side — the tool
+an operator points at a ``sim --events-dump`` artifact (or a crash
+flight-recorder dump's ``causal`` section) to answer the questions a
+reorg or a partition actually raises:
+
+* **merge** — one causally-consistent total order over all nodes' events
+  (sorted by ``(lamport, node, seq)``; a deliver can never sort before
+  its send).
+* **fork_tree** — the block DAG reconstructed from mine events: fork
+  points, per-node final tips, the canonical chain, and the orphaned
+  (reorged-away) blocks.
+* **reorg audit** — which rank adopted which suffix, which announcements
+  addressed to it were dropped vs partition-deferred, and whether that
+  loss explains the fork it had to heal from.
+* **convergence stats** — announcement propagation latency (in sim
+  steps) and the run's overall convergence picture.
+* **trace_export** — the merged order as Chrome trace-event JSON
+  (logical time on the timeline axis), viewable in Perfetto.
+
+CLI::
+
+    python -m mpi_blockchain_tpu.forensics --events causal.json \\
+        [--trace trace.json] [--json]
+
+Everything here is a pure function of the dump: running the CLI twice on
+the same artifact (or on two same-seed runs) produces byte-identical
+reports — the determinism tests assert this.
+"""
+from __future__ import annotations
+
+from ..telemetry.causal import load_causal_dump  # noqa: F401
+from .fork_tree import (build_fork_tree, convergence_stats,  # noqa: F401
+                        reorg_audit)
+from .merge import merge_events, node_order  # noqa: F401
+from .trace_export import to_chrome_trace  # noqa: F401
+
+
+def analyze_dump(dump: dict) -> dict:
+    """The full forensics report for one causal dump (the CLI's payload)."""
+    merged = merge_events(dump)
+    tree = build_fork_tree(merged)
+    return {
+        "meta": dump.get("meta", {}),
+        "nodes": node_order(dump),
+        "events_merged": len(merged),
+        "fork_tree": tree,
+        "reorg_audit": reorg_audit(merged, tree),
+        "convergence": convergence_stats(merged, tree),
+    }

@@ -1,0 +1,101 @@
+"""CLI: python -m mpi_blockchain_tpu.forensics
+
+Point it at a causal event dump (``sim --events-dump PATH``, or a flight
+recorder artifact's ``causal`` section re-wrapped) and it reconstructs
+the cross-rank story: merged causal order, fork tree, reorg audit,
+convergence stats, and optionally a Perfetto-viewable Chrome trace.
+
+    python -m mpi_blockchain_tpu.forensics --events causal.json
+    python -m mpi_blockchain_tpu.forensics --events causal.json --json
+    python -m mpi_blockchain_tpu.forensics --events causal.json \\
+        --trace trace.json     # load at ui.perfetto.dev
+
+The report is a pure function of the dump: identical input (or two
+same-seed sim runs) -> byte-identical output.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+from . import analyze_dump, load_causal_dump, merge_events, to_chrome_trace
+
+
+def _human_report(report: dict, out) -> None:
+    tree = report["fork_tree"]
+    conv = report["convergence"]
+    print(f"nodes: {', '.join(report['nodes'])}", file=out)
+    print(f"events merged: {report['events_merged']}", file=out)
+    print(f"blocks: {len(tree['blocks'])} "
+          f"(canonical {len(tree['canonical_chain'])}, "
+          f"orphaned {len(tree['orphaned'])})", file=out)
+    print(f"fork points: {len(tree['fork_points'])}", file=out)
+    for prev, sibs in tree["fork_points"].items():
+        print(f"  {prev} -> {', '.join(sibs)}", file=out)
+    print(f"converged: {tree['converged']} "
+          f"(canonical tip {tree['canonical_tip']}, "
+          f"height {conv['canonical_height']})", file=out)
+    print(f"tips: " + ", ".join(f"{n}={t}"
+                                for n, t in tree["tips"].items()),
+          file=out)
+    lat = conv["delivery_latency_steps"]
+    print(f"announcements: {conv['announcements']}, "
+          f"deliveries: {conv['deliveries']}, "
+          f"latency steps p50/max: {lat['p50']}/{lat['max']}", file=out)
+    print(f"reorgs: {conv['reorgs']}", file=out)
+    for a in report["reorg_audit"]:
+        loss = ("dropped=" + ",".join(a["announcements_dropped"])
+                if a["announcements_dropped"] else "")
+        defer = ("deferred=" + ",".join(
+            a["announcements_partition_deferred"])
+            if a["announcements_partition_deferred"] else "")
+        why = " ".join(x for x in (loss, defer) if x) or "no recorded loss"
+        print(f"  step {a['step']}: node {a['node']} rolled back "
+              f"{a['rolled_back']} ({','.join(a['rolled_back_hashes'])}) "
+              f"adopting {a['adopted']} -> {a['new_tip']}; {why} "
+              f"[loss_explains_fork={a['loss_explains_fork']}]", file=out)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m mpi_blockchain_tpu.forensics",
+        description="merge per-node causal logs; reconstruct fork tree, "
+                    "reorg audit, convergence stats; export Chrome trace")
+    parser.add_argument("--events", required=True, metavar="PATH",
+                        help="causal event dump (sim --events-dump PATH)")
+    parser.add_argument("--trace", metavar="PATH", default=None,
+                        help="also write Chrome trace-event JSON here "
+                             "(view at ui.perfetto.dev)")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="emit the full report as sorted JSON")
+    args = parser.parse_args(argv)
+
+    try:
+        dump = load_causal_dump(args.events)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"forensics: cannot read events dump: {e}", file=sys.stderr)
+        return 2
+
+    report = analyze_dump(dump)
+    if args.trace:
+        trace = to_chrome_trace(merge_events(dump))
+        pathlib.Path(args.trace).write_text(
+            json.dumps(trace, sort_keys=True))
+        print(f"trace: {args.trace} ({len(trace['traceEvents'])} events)",
+              file=sys.stderr)
+    try:
+        if args.as_json:
+            print(json.dumps(report, sort_keys=True, indent=2))
+        else:
+            _human_report(report, sys.stdout)
+    except BrokenPipeError:
+        # `forensics ... | head` is normal usage for a multi-line report;
+        # a closed pipe is the reader's choice, not our failure.
+        sys.stderr.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -21,7 +21,26 @@ from typing import Callable
 from . import core
 from .backend import MinerBackend, backend_from_config
 from .config import ConfigError, MinerConfig, extend_payload
-from .telemetry import counter, gauge, histogram
+from .telemetry import CausalLog, counter, dump_causal_logs, gauge, histogram
+
+# RecvResult codes as stable event vocabulary for the causal logs.
+_RESULT_NAMES = {
+    core.RecvResult.APPENDED: "appended",
+    core.RecvResult.DUPLICATE: "duplicate",
+    core.RecvResult.STALE_OR_FORK: "stale_or_fork",
+    core.RecvResult.INVALID: "invalid",
+    core.RecvResult.REORGED: "reorged",
+    core.RecvResult.IGNORED_SHORTER: "ignored_shorter",
+}
+
+
+def _hdr_info(header80: bytes) -> dict:
+    """Block identity fields every causal event carries: short hash,
+    short prev hash, and height (timestamps are structural: ts == height)."""
+    f = core.HeaderFields.unpack(header80)
+    return {"hash": core.header_hash(header80).hex()[:12],
+            "prev": f.prev_hash.hex()[:12],
+            "height": f.timestamp}
 
 
 @dataclasses.dataclass
@@ -30,6 +49,7 @@ class _Message:
     deliver_step: int
     sender: int
     header80: bytes
+    lamport: int = 0   # the sender's Lamport stamp at broadcast time
 
 
 @dataclasses.dataclass
@@ -77,6 +97,13 @@ class SimNode:
             backend = backend_from_config(config, cpu_ranks=1)
         self.backend = backend
         self.stats = GroupStats()
+        # Causal observability: every bus interaction this node takes part
+        # in is stamped into its bounded Lamport-clock log (telemetry/
+        # causal.py) — the forensics CLI merges these across nodes.
+        self.causal = CausalLog(node_id)
+        # The bus's current step, mirrored in by Network.step() so events
+        # recorded inside node methods carry the simulation time too.
+        self.sim_step = 0
         # Per-height search position, so a group resumes its sweep across
         # steps instead of restarting at nonce 0 (restarting would let a
         # slower group never finish a block at higher difficulty).
@@ -120,6 +147,7 @@ class SimNode:
             return None
         winner = core.set_nonce(cand, res.nonce)
         assert self.node.submit(winner), "own block failed validation"
+        self.causal.record("mine", step=self.sim_step, **_hdr_info(winner))
         self.stats.blocks_mined += 1
         self._next_nonce = 0
         self._extra_nonce = 0
@@ -139,9 +167,19 @@ class SimNode:
                 return height
         return 0
 
-    def receive(self, header80: bytes, peer: "SimNode") -> None:
-        """Consensus on a peer announcement (SURVEY.md §3.3)."""
+    def receive(self, header80: bytes, peer: "SimNode",
+                lamport: int | None = None) -> None:
+        """Consensus on a peer announcement (SURVEY.md §3.3).
+
+        ``lamport`` is the announcement's causal stamp (from the bus
+        message); receipt merges it into this node's clock. Direct calls
+        without a stamp (tests, ad-hoc wiring) record a plain local event.
+        """
         r = self.node.receive(header80)
+        self.causal.record("deliver", merge=lamport, step=self.sim_step,
+                           sender=peer.id,
+                           result=_RESULT_NAMES.get(r, str(r)),
+                           **_hdr_info(header80))
         if r == core.RecvResult.APPENDED:
             self.stats.blocks_accepted_from_peers += 1
         elif r == core.RecvResult.STALE_OR_FORK:
@@ -169,10 +207,31 @@ class SimNode:
                    for h in locator_heights(own_height)]
         anchor = peer.find_anchor(locator)
         suffix = peer.node.headers_from(anchor)
+        # The sync is a request/response exchange with TWO causal edges:
+        # our request reaches the peer (its serve event merges OUR clock),
+        # and its response reaches us (our sync event merges the serve
+        # stamp) — so a suffix adoption is always causally after the
+        # serve, and the serve always after the deliver that triggered it.
+        serve = peer.causal.record("serve_headers",
+                                   merge=self.causal.clock.time,
+                                   step=peer.sim_step,
+                                   requester=self.id, anchor=anchor,
+                                   count=len(suffix))
+        self.causal.record("sync", merge=serve["lamport"],
+                           step=self.sim_step, peer=peer.id, anchor=anchor,
+                           fetched=len(suffix))
         self.stats.headers_fetched += len(suffix)
         res = self._adopt(anchor, suffix, own_height)
         if res == core.RecvResult.INVALID and anchor > 0:
             full = peer.node.all_headers()
+            serve = peer.causal.record("serve_headers",
+                                       merge=self.causal.clock.time,
+                                       step=peer.sim_step,
+                                       requester=self.id, anchor=0,
+                                       count=len(full))
+            self.causal.record("sync", merge=serve["lamport"],
+                               step=self.sim_step, peer=peer.id, anchor=0,
+                               fetched=len(full))
             self.stats.headers_fetched += len(full)
             self._adopt(0, full, own_height)
 
@@ -180,11 +239,20 @@ class SimNode:
                own_height: int) -> int:
         old = [self.node.block_hash(i)
                for i in range(anchor + 1, own_height + 1)]
+        old_tip = self.node.tip_hash.hex()[:12]
         res = self.node.adopt_suffix(anchor, suffix)
         if res == core.RecvResult.REORGED:
-            rolled_back = sum(1 for d in old if self.node.find(d) < 0)
-            self.stats.blocks_adopted += (self.node.height - own_height
-                                          + rolled_back)
+            rolled_hashes = [d.hex()[:12] for d in old
+                             if self.node.find(d) < 0]
+            rolled_back = len(rolled_hashes)
+            adopted = self.node.height - own_height + rolled_back
+            self.causal.record("adopt", step=self.sim_step,
+                               old_tip=old_tip,
+                               new_tip=self.node.tip_hash.hex()[:12],
+                               height=self.node.height, anchor=anchor,
+                               adopted=adopted, rolled_back=rolled_back,
+                               rolled_back_hashes=rolled_hashes)
+            self.stats.blocks_adopted += adopted
             if rolled_back:
                 self.stats.reorgs += 1
                 self.stats.reorged_away_blocks += rolled_back
@@ -215,6 +283,10 @@ class Network:
         self.partitioned_until = partitioned_until
         self.queue: list[_Message] = []
         self.step_count = 0
+        # The bus's own causal log: drops and partition-deferrals happen
+        # IN the network, not on any node, so they are recorded by a
+        # pseudo-node "bus" whose clock merges each message's send stamp.
+        self.causal = CausalLog("bus")
 
     def _blocked(self, step: int, sender: int, receiver: int) -> bool:
         if self.partitioned_until is not None and step < self.partitioned_until:
@@ -226,9 +298,13 @@ class Network:
     def broadcast(self, sender: int, header80: bytes) -> None:
         counter("sim_messages_sent_total",
                 help="block announcements enqueued on the bus").inc()
-        self.queue.append(_Message(self.step_count,
-                                   self.step_count + self.delay_steps,
-                                   sender, header80))
+        deliver_step = self.step_count + self.delay_steps
+        rec = self.nodes[sender].causal.record(
+            "send", step=self.step_count, deliver_step=deliver_step,
+            **_hdr_info(header80))
+        self.queue.append(_Message(self.step_count, deliver_step,
+                                   sender, header80,
+                                   lamport=rec["lamport"]))
 
     def deliver_due(self, horizon: int = 0) -> None:
         """Delivers messages with deliver_step <= step_count + horizon.
@@ -237,6 +313,13 @@ class Network:
         be due up to delay_steps in the future, and no further mining steps
         will advance the clock to meet them.
         """
+        # Mirror the bus clock into every node so node-side events
+        # (deliver/sync/adopt) carry the SAME step as the bus-side
+        # drop/defer events of this delivery round — including the
+        # post-target flush, which runs after step() incremented the
+        # clock past the nodes' last mirrored value.
+        for node in self.nodes:
+            node.sim_step = self.step_count
         cutoff = self.step_count + horizon
         due = [m for m in self.queue if m.deliver_step <= cutoff]
         self.queue = [m for m in self.queue if m.deliver_step > cutoff]
@@ -256,20 +339,29 @@ class Network:
                         counter("sim_messages_partition_deferred_total",
                                 help="deliveries deferred to the "
                                      "partition heal").inc()
+                        self.causal.record(
+                            "defer", merge=m.lamport, step=self.step_count,
+                            sender=m.sender, receiver=node.id,
+                            until_step=self.partitioned_until,
+                            **_hdr_info(m.header80))
                         self.queue.append(dataclasses.replace(
                             m, deliver_step=self.partitioned_until))
                     else:
                         counter("sim_messages_dropped_total",
                                 help="deliveries lost to the drop "
                                      "schedule").inc()
+                        self.causal.record(
+                            "drop", merge=m.lamport, step=self.step_count,
+                            sender=m.sender, receiver=node.id,
+                            **_hdr_info(m.header80))
                     continue
-                node.receive(m.header80, sender_node)
+                node.receive(m.header80, sender_node, lamport=m.lamport)
                 counter("sim_messages_delivered_total",
                         help="announcements delivered to a peer").inc()
 
     def step(self, nonce_budget: int = 1 << 16) -> None:
         """One simulation step: deliver, then every group mines a slice."""
-        self.deliver_due()
+        self.deliver_due()   # also mirrors step_count into node.sim_step
         for node in self.nodes:
             mined = node.mine_step(nonce_budget)
             if mined is not None:
@@ -308,11 +400,33 @@ class Network:
                 self.mirror_stats()
                 if self.converged():
                     return self.step_count
-        raise RuntimeError(f"no convergence in {max_steps} steps")
+        err = RuntimeError(f"no convergence in {max_steps} steps")
+        # The failed network IS the post-mortem: callers (sim CLI, flight
+        # recorder) read .network off the exception to dump causal logs.
+        err.network = self
+        raise err
 
     def converged(self) -> bool:
         tips = {n.node.tip_hash for n in self.nodes}
         return len(tips) == 1
+
+    # ---- causal observability export ------------------------------------
+
+    def causal_logs(self) -> list:
+        """Every per-node causal log plus the bus's own (drop/defer) log."""
+        return [n.causal for n in self.nodes] + [self.causal]
+
+    def dump_causal(self, path, meta: dict | None = None):
+        """Write all causal logs as one forensics-ready JSON artifact
+        (CLI: ``sim --events-dump PATH``; reader:
+        ``python -m mpi_blockchain_tpu.forensics --events PATH``)."""
+        base = {"steps": self.step_count, "converged": self.converged(),
+                "n_nodes": len(self.nodes),
+                "heights": [n.node.height for n in self.nodes],
+                "delay_steps": self.delay_steps,
+                "partitioned_until": self.partitioned_until}
+        base.update(meta or {})
+        return dump_causal_logs(self.causal_logs(), path, meta=base)
 
 
 def seeded_drop(drop_rate_pct: int, seed: int = 0
@@ -337,7 +451,9 @@ def run_adversarial(config: MinerConfig | None = None,
                     partition_steps: int = 30, target_height: int = 8,
                     nonce_budget: int = 1 << 8, delay_steps: int = 1,
                     drop_rate_pct: int = 0, seed: int = 0,
-                    n_groups: int = 2) -> Network:
+                    n_groups: int = 2,
+                    on_network: Callable[["Network"], None] | None = None
+                    ) -> Network:
     """BASELINE config 5: competing miner groups, then reconciliation.
 
     n_groups groups mine in a partition (building competing chains with
@@ -354,5 +470,10 @@ def run_adversarial(config: MinerConfig | None = None,
                   drop_fn=(seeded_drop(drop_rate_pct, seed)
                            if drop_rate_pct else None),
                   partitioned_until=partition_steps)
+    if on_network is not None:
+        # Hand the network out BEFORE the run: a non-converging run raises
+        # out of net.run, and the caller (sim CLI / flight recorder) still
+        # needs the causal logs of the failed run.
+        on_network(net)
     net.run(target_height, nonce_budget=nonce_budget)
     return net

@@ -7,6 +7,11 @@ catalogue in docs/observability.md):
   reservoirs, thread-safe, identity = (name, labels).
 * **spans** — monotonic-clock spans with parent nesting via a
   thread-local stack, mirrored into a ``span_seconds`` summary.
+* **causal** — Lamport-clock causal event logs for the multi-node
+  simulation bus (per-node bounded rings; merged into one causal order
+  by ``mpi_blockchain_tpu.forensics``).
+* **flight_recorder** — crash dump of events + causal logs + registry
+  snapshot on abnormal exit (``--flight-recorder`` on mine/sim/bench).
 * **exporters** —
   1. JSON-lines event stream (``events.emit_event``; supersedes
      ``utils.logging.block_logger``, which now delegates here),
@@ -28,6 +33,8 @@ from __future__ import annotations
 
 import pathlib
 
+from .causal import (CausalLog, LamportClock,  # noqa: F401
+                     dump_causal_logs, load_causal_dump)
 from .events import clear_events, emit_event, recent_events  # noqa: F401
 from .registry import (Counter, Gauge, Histogram, MetricError,  # noqa: F401
                        Registry, default_registry, reset)

@@ -6,15 +6,48 @@ logger at INFO — the production form of the reference's std::cout prints,
 and the supersession of ``utils.logging.block_logger`` (which now
 delegates here). Events are additionally kept in a bounded in-process
 ring so the telemetry CLI and tests can inspect what a run emitted
-without scraping log output.
+without scraping log output. The ring capacity (also the default bound
+for the per-node causal logs in ``causal.py``) is configurable via the
+``MPIBT_EVENT_BUFFER`` env var — the default 2048 silently truncates
+very long sim runs, so operators can widen it for forensics captures.
 """
 from __future__ import annotations
 
 import collections
 import json
+import os
 import threading
+import warnings
 
-EVENT_RING_SIZE = 2048
+_DEFAULT_RING_SIZE = 2048
+
+
+def env_number(name: str, default, cast=int, minimum=1):
+    """Shared observability-knob parsing: warn + fall back to the default
+    on a malformed or out-of-range value — a telemetry knob must never be
+    the thing that crashes a run. ``not v >= minimum`` also rejects NaN.
+    Used for ``MPIBT_EVENT_BUFFER`` here and
+    ``MPIBT_DEVICE_INIT_TIMEOUT`` in bench_lib.
+    """
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        v = cast(raw)
+    except ValueError:
+        v = None
+    if v is None or not v >= minimum:
+        warnings.warn(f"{name}={raw!r} is not a number >= {minimum}; "
+                      f"using default {default}", RuntimeWarning,
+                      stacklevel=2)
+        return default
+    return v
+
+
+# Ring capacity (default 2048, min 1). The bound is deliberate — a
+# week-long sim run must not grow the process without limit — but it
+# truncates very long runs, so the cap is operator-tunable.
+EVENT_RING_SIZE = env_number("MPIBT_EVENT_BUFFER", _DEFAULT_RING_SIZE)
 
 _ring: collections.deque = collections.deque(maxlen=EVENT_RING_SIZE)
 _lock = threading.Lock()

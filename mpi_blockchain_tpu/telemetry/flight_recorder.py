@@ -1,0 +1,203 @@
+"""Crash flight recorder: dump telemetry state on abnormal exit.
+
+A hung device bench or a non-converging fault-injection run used to die
+with one opaque line ("device bench timed out after 900s") and take all
+of its telemetry with it. The flight recorder keeps the post-mortem: it
+installs ``sys.excepthook`` + ``atexit`` hooks and, on any abnormal
+exit, writes a single JSON artifact containing
+
+* the crash reason (formatted traceback, watchdog message, or the
+  ``mark_abnormal`` reason),
+* the last-N JSON-line events from the global ring,
+* the per-node causal logs of every registered simulation network,
+* a full metrics-registry snapshot and the span-log tail,
+* process context (argv, pid, wall time, extra key/values).
+
+Three trigger paths:
+
+1. **Uncaught exception** — the excepthook dumps immediately, then
+   chains to the previous hook (the traceback still prints).
+2. **Declared abnormal exit** — a caller that handles its own failure
+   (the sim CLI's non-convergence path, a bench watchdog) calls
+   ``dump_now(reason)`` directly, or ``mark_abnormal(reason)`` so the
+   atexit hook dumps at interpreter shutdown.
+3. **Normal exit** — no artifact. The recorder is evidence on failure,
+   not a second metrics exporter.
+
+Enable it with ``install(path)`` — the mine/sim/bench CLIs wire this to
+``--flight-recorder PATH`` (or env ``MPIBT_FLIGHT_RECORDER``).
+"""
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import pathlib
+import sys
+import threading
+import time
+import traceback
+
+DEFAULT_LAST_N = 256
+
+_lock = threading.Lock()
+_state: dict = {
+    "path": None,
+    "last_n": DEFAULT_LAST_N,
+    "installed": False,
+    "prev_excepthook": None,
+    "abnormal_reason": None,
+    "dumped": False,
+    "reasons": [],     # every dump reason so far, oldest first
+    "networks": [],
+    "context": {},
+}
+
+
+def install(path=None, last_n: int = DEFAULT_LAST_N) -> pathlib.Path:
+    """Arm the recorder. ``path`` defaults to env ``MPIBT_FLIGHT_RECORDER``
+    or ``flight_recorder_<pid>.json`` in the CWD. Idempotent (re-install
+    just updates path/last_n)."""
+    with _lock:
+        _state["path"] = pathlib.Path(
+            path or os.environ.get("MPIBT_FLIGHT_RECORDER")
+            or f"flight_recorder_{os.getpid()}.json")
+        _state["last_n"] = max(1, int(last_n))
+        _state["dumped"] = False
+        _state["reasons"] = []
+        _state["abnormal_reason"] = None
+        if not _state["installed"]:
+            _state["installed"] = True
+            _state["prev_excepthook"] = sys.excepthook
+            sys.excepthook = _excepthook
+            atexit.register(_atexit_hook)
+        return _state["path"]
+
+
+def uninstall() -> None:
+    """Disarm (test isolation). The atexit registration stays but becomes
+    a no-op once ``installed`` is False."""
+    with _lock:
+        if _state["installed"] and _state["prev_excepthook"] is not None:
+            sys.excepthook = _state["prev_excepthook"]
+        _state.update(installed=False, prev_excepthook=None, path=None,
+                      abnormal_reason=None, dumped=False, reasons=[],
+                      networks=[], context={})
+
+
+def installed() -> bool:
+    with _lock:
+        return _state["installed"]
+
+
+def register_network(net) -> None:
+    """Attach a simulation network (anything with ``causal_logs()``) so
+    its per-node causal logs land in the dump."""
+    with _lock:
+        if net not in _state["networks"]:
+            _state["networks"].append(net)
+
+
+def register_context(**kv) -> None:
+    """Attach static context (config, seed, ...) to future dumps."""
+    with _lock:
+        _state["context"].update(kv)
+
+
+def mark_abnormal(reason: str) -> None:
+    """Declare this exit abnormal: the atexit hook will dump with this
+    reason even if no exception escapes (e.g. a clean ``return 1``)."""
+    with _lock:
+        _state["abnormal_reason"] = str(reason)
+
+
+def dump_now(reason: str) -> pathlib.Path | None:
+    """Write the artifact immediately (no-op unless installed). Used by
+    watchdogs that fire while the process is still alive — the artifact
+    must exist BEFORE a parent kills us. A later crash dump OVERWRITES
+    this one (carrying its reason in ``prior_reasons``): the
+    most-specific failure wins, an early advisory dump never masks it."""
+    return _dump(reason)
+
+
+def _snapshot(reason: str, tb: str | None = None) -> dict:
+    # Late imports: the recorder must be importable before telemetry is
+    # fully initialized, and must never fail a crash path on an import.
+    from .events import recent_events
+    from .registry import default_registry
+
+    with _lock:
+        last_n = _state["last_n"]
+        networks = list(_state["networks"])
+        context = dict(_state["context"])
+    reg = default_registry()
+    causal: dict = {}
+    for i, net in enumerate(networks):
+        # First network keeps flat node keys (the common case's stable
+        # schema); later ones are prefixed so two registered sims can
+        # never silently overwrite each other's logs.
+        prefix = "" if i == 0 else f"net{i}:"
+        try:
+            for log in net.causal_logs():
+                causal[f"{prefix}{log.node_id}"] = log.events()[-last_n:]
+        except Exception as e:  # a half-built network must not mask the crash
+            causal.setdefault("_error", str(e))
+    return {
+        "artifact": "flight_recorder",
+        "reason": reason,
+        "traceback": tb,
+        "wall_time": time.time(),
+        "pid": os.getpid(),
+        "argv": list(sys.argv),
+        "context": context,
+        "events": recent_events(last_n),
+        "causal": causal,
+        "metrics": reg.snapshot(),
+        "spans": [s.to_dict() for s in reg.spans()[-last_n:]],
+    }
+
+
+def _dump(reason: str, tb: str | None = None,
+          only_if_first: bool = False) -> pathlib.Path | None:
+    """Write the artifact. ``only_if_first`` (the atexit path) refuses to
+    overwrite an earlier, more specific dump; direct dumps (excepthook,
+    watchdog dump_now) always write, recording superseded reasons in
+    ``prior_reasons`` so an advisory dump can never swallow a real crash."""
+    with _lock:
+        if not _state["installed"]:
+            return None
+        if only_if_first and _state["dumped"]:
+            return None
+        prior = list(_state["reasons"])
+        path = _state["path"]
+    try:
+        payload = _snapshot(reason, tb)
+        payload["prior_reasons"] = prior
+        tmp = path.with_suffix(path.suffix + ".tmp")
+        tmp.write_text(json.dumps(payload, sort_keys=True, default=str))
+        tmp.replace(path)
+    except Exception as e:
+        # The recorder must never turn one failure into two — and a
+        # FAILED write must not latch `dumped`, or it would suppress the
+        # atexit fallback that might still succeed.
+        print(f"flight-recorder dump failed: {e}", file=sys.stderr)
+        return None
+    with _lock:
+        _state["reasons"].append(reason)
+        _state["dumped"] = True
+    return path
+
+
+def _excepthook(exc_type, exc, tb) -> None:
+    _dump(f"uncaught {exc_type.__name__}: {exc}",
+          tb="".join(traceback.format_exception(exc_type, exc, tb)))
+    prev = _state["prev_excepthook"] or sys.__excepthook__
+    prev(exc_type, exc, tb)
+
+
+def _atexit_hook() -> None:
+    with _lock:
+        reason = _state["abnormal_reason"]
+        active = _state["installed"]
+    if active and reason is not None:
+        _dump(reason, only_if_first=True)

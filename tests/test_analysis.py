@@ -217,3 +217,79 @@ def test_sanitizer_matrix_rules(tmp_path):
 def test_real_makefile_has_full_matrix():
     findings = run_sanitizers(ROOT, notes=[])
     assert not [f for f in findings if f.rule in ("SAN001", "SAN002")]
+
+
+# ---- TEL001: causal-stamp discipline on the sim bus --------------------
+
+
+SIM_PY = ROOT / "mpi_blockchain_tpu" / "simulation.py"
+
+
+def _drifted_sim(tmp_path, snippet):
+    """The live simulation.py plus one injected drift function."""
+    path = tmp_path / "simulation.py"
+    path.write_text(SIM_PY.read_text() + textwrap.dedent(snippet))
+    return path
+
+
+def test_tel001_raw_emit_event_missing_stamp_fires(tmp_path):
+    from mpi_blockchain_tpu.analysis.telemetry_lint import run_telemetry_lint
+
+    drifted = _drifted_sim(tmp_path, """
+
+    def _drifted_announce(header80):
+        from .telemetry import emit_event
+        emit_event({"event": "sim.announce",
+                    "hash": header80[:4].hex()})
+    """)
+    findings = run_telemetry_lint(ROOT, overrides={"sim_py": drifted})
+    assert rule_set(findings) == {"TEL001"}
+    assert "lamport" in findings[0].message
+
+
+def test_tel001_non_literal_payload_fires(tmp_path):
+    from mpi_blockchain_tpu.analysis.telemetry_lint import run_telemetry_lint
+
+    drifted = _drifted_sim(tmp_path, """
+
+    def _drifted_forward(record):
+        from .telemetry import emit_event
+        emit_event(record)
+    """)
+    findings = run_telemetry_lint(ROOT, overrides={"sim_py": drifted})
+    assert rule_set(findings) == {"TEL001"}
+    assert "non-literal" in findings[0].message
+
+
+def test_tel001_stamped_literal_passes(tmp_path):
+    from mpi_blockchain_tpu.analysis.telemetry_lint import run_telemetry_lint
+
+    stamped = _drifted_sim(tmp_path, """
+
+    def _stamped_announce(node_id, lamport):
+        from .telemetry import emit_event
+        emit_event({"event": "sim.announce", "node": node_id,
+                    "lamport": lamport})
+    """)
+    assert run_telemetry_lint(ROOT, overrides={"sim_py": stamped}) == []
+
+
+def test_tel001_live_simulation_clean():
+    from mpi_blockchain_tpu.analysis.telemetry_lint import run_telemetry_lint
+
+    assert run_telemetry_lint(ROOT) == []
+
+
+def test_tel001_cli_pass_family(tmp_path):
+    drifted = _drifted_sim(tmp_path, """
+
+    def _drifted_announce():
+        from .telemetry import emit_event
+        emit_event({"event": "sim.announce"})
+    """)
+    proc = subprocess.run(
+        [sys.executable, "-m", "mpi_blockchain_tpu.analysis",
+         "--passes", "telemetry", "--override", f"sim_py={drifted}"],
+        cwd=ROOT, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "TEL001" in proc.stdout

@@ -1,0 +1,477 @@
+"""Forensics subsystem tests: causal logs, merge determinism, fork tree,
+reorg audit, flight recorder, and the CLI acceptance criteria.
+
+The ISSUE acceptance as executable assertions: a seeded 4-node partition
+run reconstructs the fork tree and reorg audit deterministically across
+two runs, and the Chrome trace export json-loads with >= 1 event per
+node.
+"""
+import json
+import pathlib
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from mpi_blockchain_tpu import telemetry
+from mpi_blockchain_tpu.config import MinerConfig
+from mpi_blockchain_tpu.forensics import (analyze_dump, build_fork_tree,
+                                          convergence_stats, load_causal_dump,
+                                          merge_events, reorg_audit,
+                                          to_chrome_trace)
+from mpi_blockchain_tpu.simulation import Network, SimNode, run_adversarial
+from mpi_blockchain_tpu.telemetry.causal import (CausalLog, LamportClock,
+                                                 dump_causal_logs)
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+# The ISSUE's acceptance scenario: seeded 4-node partition + drops.
+SCENARIO = dict(partition_steps=15, target_height=4, drop_rate_pct=20,
+                seed=3, n_groups=4)
+
+
+@pytest.fixture(autouse=True)
+def fresh_registry():
+    telemetry.reset()
+    telemetry.clear_events()
+    yield
+    telemetry.reset()
+    telemetry.clear_events()
+
+
+# ---- Lamport clock / causal log primitives -----------------------------
+
+
+def test_lamport_clock_tick_and_merge():
+    c = LamportClock()
+    assert c.tick() == 1
+    assert c.tick() == 2
+    # Merge advances past a larger remote stamp...
+    assert c.merge(10) == 11
+    # ...and past the local time when the remote is older.
+    assert c.merge(3) == 12
+    assert c.time == 12
+
+
+def test_causal_log_stamps_and_bounds():
+    log = CausalLog(7, capacity=4)
+    for i in range(10):
+        log.record("k", step=i, payload=i)
+    events = log.events()
+    assert len(events) == 4                      # bounded ring
+    assert [e["payload"] for e in events] == [6, 7, 8, 9]  # newest kept
+    for e in events:
+        assert e["node"] == 7
+        assert set(e) >= {"node", "lamport", "seq", "step", "kind"}
+    # lamport and seq strictly increase per node.
+    assert all(a["lamport"] < b["lamport"] and a["seq"] < b["seq"]
+               for a, b in zip(events, events[1:]))
+
+
+def test_causal_log_merge_orders_cross_node():
+    a, b = CausalLog(0), CausalLog(1)
+    send = a.record("send")
+    recv = b.record("deliver", merge=send["lamport"])
+    assert recv["lamport"] > send["lamport"]     # happened-before holds
+
+
+# ---- simulation instrumentation ----------------------------------------
+
+
+def run_scenario(**overrides):
+    kw = dict(SCENARIO)
+    kw.update(overrides)
+    return run_adversarial(**kw)
+
+
+def test_sim_emits_causal_events_on_every_node():
+    net = run_scenario()
+    for log in net.causal_logs():
+        events = log.events()
+        assert events, f"node {log.node_id} emitted nothing"
+        for e in events:
+            assert set(e) >= {"node", "lamport", "seq", "step", "kind"}
+            assert e["node"] == log.node_id
+        lamports = [e["lamport"] for e in events]
+        assert lamports == sorted(lamports)
+        assert all(x < y for x, y in zip(lamports, lamports[1:]))
+
+
+def test_send_happens_before_its_delivers():
+    net = run_scenario()
+    merged = merge_events({"nodes": {
+        str(log.node_id): log.events() for log in net.causal_logs()}})
+    first_send = {}
+    for e in merged:
+        if e["kind"] == "send" and e["hash"] not in first_send:
+            first_send[e["hash"]] = e
+        elif e["kind"] == "deliver" and e["hash"] in first_send:
+            assert e["lamport"] > first_send[e["hash"]]["lamport"]
+
+
+def test_deterministic_replay_identical_dumps(tmp_path):
+    """Same seed -> byte-identical causal dumps, merged order, fork tree."""
+    p1, p2 = tmp_path / "a.json", tmp_path / "b.json"
+    run_scenario().dump_causal(p1, meta={"seed": SCENARIO["seed"]})
+    run_scenario().dump_causal(p2, meta={"seed": SCENARIO["seed"]})
+    assert p1.read_text() == p2.read_text()
+    d1, d2 = load_causal_dump(p1), load_causal_dump(p2)
+    assert merge_events(d1) == merge_events(d2)
+    assert build_fork_tree(merge_events(d1)) == \
+        build_fork_tree(merge_events(d2))
+
+
+def test_fork_tree_reconstructs_partition_fork():
+    net = run_scenario()
+    merged = merge_events({"nodes": {
+        str(log.node_id): log.events() for log in net.causal_logs()}})
+    tree = build_fork_tree(merged)
+    assert tree["blocks"]
+    # The partition forced competing chains: at least one fork point,
+    # and the losers' blocks are orphaned off the canonical chain.
+    assert tree["fork_points"]
+    assert tree["orphaned"]
+    assert tree["converged"]
+    # All nodes ended on the canonical tip, which matches the live sim.
+    tips = set(tree["tips"].values())
+    assert tips == {tree["canonical_tip"]}
+    assert tree["canonical_tip"] == net.nodes[0].node.tip_hash.hex()[:12]
+    # The canonical chain links prev -> hash contiguously.
+    blocks = tree["blocks"]
+    for parent, child in zip(tree["canonical_chain"],
+                             tree["canonical_chain"][1:]):
+        assert blocks[child]["prev"] == parent
+
+
+def test_reorg_audit_matches_group_stats_and_explains_loss():
+    net = run_scenario()
+    merged = merge_events({"nodes": {
+        str(log.node_id): log.events() for log in net.causal_logs()}})
+    tree = build_fork_tree(merged)
+    audit = reorg_audit(merged, tree)
+    # One audit entry per reorg the live sim counted, with matching
+    # rolled-back totals per node (the logs were not truncated here).
+    assert len(audit) == sum(n.stats.reorgs for n in net.nodes)
+    for node in net.nodes:
+        rolled = sum(a["rolled_back"] for a in audit
+                     if a["node"] == node.id)
+        assert rolled == node.stats.reorged_away_blocks
+    # A partition fork IS explained by message loss: the winning suffix's
+    # announcements to the loser were deferred (or dropped) on the bus.
+    assert audit, "partition scenario must produce at least one reorg"
+    assert any(a["loss_explains_fork"] for a in audit)
+    explained = [a for a in audit if a["loss_explains_fork"]]
+    assert all(a["announcements_partition_deferred"]
+               or a["announcements_dropped"] for a in explained)
+
+
+def test_convergence_stats_shape():
+    net = run_scenario()
+    merged = merge_events({"nodes": {
+        str(log.node_id): log.events() for log in net.causal_logs()}})
+    tree = build_fork_tree(merged)
+    conv = convergence_stats(merged, tree)
+    assert conv["converged"] is True
+    assert conv["announcements"] > 0
+    assert conv["deliveries"] > 0
+    lat = conv["delivery_latency_steps"]
+    assert lat["count"] > 0 and lat["max"] >= lat["p50"] >= 0
+    assert conv["reorgs"] == sum(n.stats.reorgs for n in net.nodes)
+    assert conv["canonical_height"] == net.nodes[0].node.height
+
+
+def test_direct_receive_without_stamp_still_logs():
+    # Tests and ad-hoc wiring call receive() without a bus stamp; the
+    # event must still be recorded (as a local tick, not a merge).
+    cfg = MinerConfig(difficulty_bits=8, n_blocks=2, backend="cpu")
+    a, b = SimNode(0, cfg), SimNode(1, cfg)
+    hdr = None
+    while hdr is None:
+        hdr = a.mine_step(1 << 12)
+    b.receive(hdr, a)
+    kinds = [e["kind"] for e in b.causal.events()]
+    assert kinds[-1] == "deliver"
+    assert b.causal.events()[-1]["result"] == "appended"
+
+
+# ---- chrome trace export -----------------------------------------------
+
+
+def test_chrome_trace_has_rows_for_every_node():
+    net = run_scenario()
+    merged = merge_events({"nodes": {
+        str(log.node_id): log.events() for log in net.causal_logs()}})
+    trace = to_chrome_trace(merged)
+    # Round-trips through JSON and has >= 1 slice per node + bus row.
+    blob = json.loads(json.dumps(trace))
+    slices = [e for e in blob["traceEvents"] if e["ph"] == "X"]
+    pids = {e["pid"] for e in slices}
+    assert pids == {0, 1, 2, 3, 4}   # bus=0, nodes 0..3 -> 1..4
+    names = {e["name"] for e in slices}
+    assert {"mine", "send", "deliver", "adopt"} <= names
+    # Flow arrows pair sends with delivers on announcement ids.
+    starts = {e["id"] for e in blob["traceEvents"] if e["ph"] == "s"}
+    finishes = {e["id"] for e in blob["traceEvents"] if e["ph"] == "f"}
+    assert finishes <= starts and finishes
+
+
+# ---- the CLI acceptance criterion --------------------------------------
+
+
+def _run_cli_scenario(tmp_path, tag):
+    from mpi_blockchain_tpu.cli import main as cli_main
+    from mpi_blockchain_tpu.forensics.__main__ import main as forensics_main
+
+    dump = tmp_path / f"causal_{tag}.json"
+    trace = tmp_path / f"trace_{tag}.json"
+    report = tmp_path / f"report_{tag}.json"
+    rc = cli_main(["sim", "--groups", "4", "--drop-rate", "20",
+                   "--seed", "3", "--blocks", "4",
+                   "--partition-steps", "15",
+                   "--events-dump", str(dump)])
+    assert rc == 0
+    import contextlib
+    import io
+    out = io.StringIO()
+    with contextlib.redirect_stdout(out):
+        rc = forensics_main(["--events", str(dump),
+                             "--trace", str(trace), "--json"])
+    assert rc == 0
+    report.write_text(out.getvalue())
+    return dump, trace, report
+
+
+def test_forensics_cli_acceptance_deterministic(tmp_path, capsys):
+    """ISSUE acceptance: seeded 4-node partition run -> deterministic
+    fork tree + reorg audit across two runs, and a Chrome trace that
+    json.loads with >= 1 event per node."""
+    _, trace1, report1 = _run_cli_scenario(tmp_path, "run1")
+    _, trace2, report2 = _run_cli_scenario(tmp_path, "run2")
+    capsys.readouterr()      # swallow the sim CLI's own stdout
+    assert report1.read_text() == report2.read_text()
+    assert trace1.read_text() == trace2.read_text()
+    r = json.loads(report1.read_text())
+    assert r["fork_tree"]["blocks"]
+    assert r["fork_tree"]["fork_points"]
+    assert r["reorg_audit"]
+    t = json.loads(trace1.read_text())
+    per_node = {}
+    for e in t["traceEvents"]:
+        if e["ph"] == "X":
+            per_node[e["pid"]] = per_node.get(e["pid"], 0) + 1
+    assert set(per_node) == {0, 1, 2, 3, 4}
+    assert all(n >= 1 for n in per_node.values())
+
+
+def test_forensics_cli_rejects_bad_dump(tmp_path, capsys):
+    from mpi_blockchain_tpu.forensics.__main__ import main as forensics_main
+
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"not_nodes": 1}))
+    assert forensics_main(["--events", str(bad)]) == 2
+    assert forensics_main(["--events", str(tmp_path / "missing.json")]) == 2
+    capsys.readouterr()
+
+
+def test_dump_load_roundtrip(tmp_path):
+    log = CausalLog(0)
+    log.record("mine", hash="aa", prev="bb", height=1)
+    p = dump_causal_logs([log], tmp_path / "d.json", meta={"x": 1})
+    d = load_causal_dump(p)
+    assert d["meta"] == {"x": 1}
+    assert d["nodes"]["0"][0]["hash"] == "aa"
+    with pytest.raises(ValueError, match="missing 'nodes'"):
+        bad = tmp_path / "bad.json"
+        bad.write_text("{}")
+        load_causal_dump(bad)
+
+
+# ---- flight recorder ---------------------------------------------------
+
+_CRASH_PRELUDE = """
+import sys
+sys.path.insert(0, {root!r})
+from mpi_blockchain_tpu.telemetry import counter, emit_event, flight_recorder
+flight_recorder.install({path!r}, last_n=8)
+counter("crash_test_total").inc(3)
+emit_event({{"event": "pre_crash", "n": 1}})
+"""
+
+
+def _run_crash_script(tmp_path, body):
+    art = tmp_path / "fr.json"
+    script = textwrap.dedent(
+        _CRASH_PRELUDE.format(root=str(ROOT), path=str(art))) + \
+        textwrap.dedent(body)
+    proc = subprocess.run([sys.executable, "-c", script], cwd=ROOT,
+                          capture_output=True, text=True, timeout=120)
+    return art, proc
+
+
+def test_flight_recorder_dumps_on_uncaught_exception(tmp_path):
+    art, proc = _run_crash_script(
+        tmp_path, 'raise ValueError("induced crash")')
+    assert proc.returncode != 0
+    assert "induced crash" in proc.stderr      # traceback still prints
+    d = json.loads(art.read_text())
+    assert d["artifact"] == "flight_recorder"
+    assert "induced crash" in d["reason"]
+    assert "ValueError" in d["traceback"]
+    assert any(e.get("event") == "pre_crash" for e in d["events"])
+    assert d["metrics"]["crash_test_total"][0]["value"] == 3
+
+
+def test_flight_recorder_dumps_on_marked_abnormal_exit(tmp_path):
+    art, proc = _run_crash_script(tmp_path, """
+        flight_recorder.mark_abnormal("watchdog: device init hang")
+        sys.exit(3)
+        """)
+    assert proc.returncode == 3
+    d = json.loads(art.read_text())
+    assert d["reason"] == "watchdog: device init hang"
+
+
+def test_flight_recorder_silent_on_clean_exit(tmp_path):
+    art, proc = _run_crash_script(tmp_path, 'sys.exit(0)')
+    assert proc.returncode == 0
+    assert not art.exists()
+
+
+def test_flight_recorder_captures_causal_logs_in_process(tmp_path):
+    from mpi_blockchain_tpu.telemetry import flight_recorder
+
+    art = tmp_path / "fr.json"
+    try:
+        flight_recorder.install(art)
+        net = run_scenario()
+        flight_recorder.register_network(net)
+        assert flight_recorder.dump_now("post-run inspection") == art
+        d = json.loads(art.read_text())
+        assert set(d["causal"]) == {"0", "1", "2", "3", "bus"}
+        assert all(d["causal"][k] for k in d["causal"])
+    finally:
+        flight_recorder.uninstall()
+
+
+def test_sim_cli_flight_recorder_on_non_convergence(tmp_path, capsys):
+    """The fault-injection failure mode: a sim that cannot converge exits
+    rc=1 AND leaves a flight-recorder artifact with the causal logs."""
+    from mpi_blockchain_tpu.cli import main as cli_main
+    from mpi_blockchain_tpu.telemetry import flight_recorder
+
+    art = tmp_path / "fr.json"
+    dump = tmp_path / "causal.json"
+    try:
+        rc = cli_main(["sim", "--groups", "2", "--difficulty", "30",
+                       "--blocks", "2", "--partition-steps", "2",
+                       "--nonce-budget-pow2", "4",
+                       "--flight-recorder", str(art),
+                       "--events-dump", str(dump)])
+    finally:
+        flight_recorder.uninstall()
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert json.loads(out.strip().splitlines()[-1])["converged"] is False
+    d = json.loads(art.read_text())
+    assert "non-convergence" in d["reason"]
+    assert "bus" in d["causal"]
+    # The events dump of the FAILED run exists too (forensics-ready).
+    assert "nodes" in json.loads(dump.read_text())
+
+
+# ---- bench.device_init phases ------------------------------------------
+
+
+def test_bench_device_init_phase_emits_event_and_span():
+    from mpi_blockchain_tpu.bench_lib import _device_init_phase
+
+    with _device_init_phase("unit_test_phase", timeout_s=60):
+        pass
+    evs = telemetry.recent_events(event="bench.device_init")
+    assert evs and evs[-1]["phase"] == "unit_test_phase"
+    assert evs[-1]["status"] == "done"
+    assert evs[-1]["elapsed_s"] >= 0
+    spans = telemetry.default_registry().spans("bench.device_init")
+    assert spans and spans[-1].attrs["phase"] == "unit_test_phase"
+
+
+def test_bench_device_init_watchdog_fires_on_hang():
+    import time
+
+    from mpi_blockchain_tpu.bench_lib import _device_init_phase
+
+    with _device_init_phase("hang_phase", timeout_s=0.05):
+        time.sleep(0.3)
+    statuses = [e["status"] for e in
+                telemetry.recent_events(event="bench.device_init")
+                if e["phase"] == "hang_phase"]
+    assert statuses == ["hang", "done"]
+
+
+def test_bench_tpu_emits_init_phases():
+    from mpi_blockchain_tpu.bench_lib import bench_tpu
+
+    bench_tpu(seconds=0.05, batch_pow2=10)
+    phases = [e["phase"] for e in
+              telemetry.recent_events(event="bench.device_init")
+              if e["status"] == "done"]
+    assert phases == ["jax_import", "backend_resolve", "kernel_build",
+                      "compile_warm"]
+
+
+def test_flight_recorder_crash_overwrites_advisory_dump(tmp_path):
+    """A watchdog's advisory dump_now must never swallow the later real
+    crash: the excepthook overwrites, keeping the old reason on record."""
+    art, proc = _run_crash_script(tmp_path, """
+        flight_recorder.dump_now("advisory: watchdog fired")
+        raise ValueError("the real crash")
+        """)
+    assert proc.returncode != 0
+    d = json.loads(art.read_text())
+    assert "the real crash" in d["reason"]
+    assert d["prior_reasons"] == ["advisory: watchdog fired"]
+
+
+def test_sim_cli_reraises_infrastructure_runtime_error(monkeypatch, capsys):
+    """Only Network.run's non-convergence (marked with .network) is a
+    consensus outcome; any other RuntimeError must keep its traceback."""
+    import mpi_blockchain_tpu.simulation as simulation
+    from mpi_blockchain_tpu.cli import main as cli_main
+
+    def boom(**kwargs):
+        raise RuntimeError("RESOURCE_EXHAUSTED: device OOM")
+
+    monkeypatch.setattr(simulation, "run_adversarial", boom)
+    with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+        cli_main(["sim", "--groups", "2", "--blocks", "2"])
+    capsys.readouterr()
+
+
+def test_device_init_phase_error_status_on_raise():
+    from mpi_blockchain_tpu.bench_lib import _device_init_phase
+
+    with pytest.raises(RuntimeError):
+        with _device_init_phase("boom_phase", timeout_s=60):
+            raise RuntimeError("induced")
+    ev = telemetry.recent_events(event="bench.device_init")[-1]
+    assert ev["phase"] == "boom_phase"
+    assert ev["status"] == "error: RuntimeError"
+
+
+def test_serve_headers_causally_after_requesting_node():
+    """The sync request edge: a peer's serve_headers merges the
+    requester's clock, so it can never sort before the deliver that
+    triggered the sync."""
+    net = run_scenario()
+    merged = merge_events({"nodes": {
+        str(log.node_id): log.events() for log in net.causal_logs()}})
+    last_lamport = {}
+    serves = 0
+    for e in merged:
+        if e["kind"] == "serve_headers":
+            serves += 1
+            req = e["requester"]
+            assert e["lamport"] > last_lamport.get(req, 0), e
+        last_lamport[e["node"]] = e["lamport"]
+    assert serves > 0

@@ -400,3 +400,39 @@ def test_cli_metrics_dump_written_on_failure(tmp_path, capsys):
     assert rc == 2                          # ConfigError path
     capsys.readouterr()
     assert "leftover" in dump.read_text()
+
+
+# ---- MPIBT_EVENT_BUFFER: configurable ring capacity --------------------
+
+
+def _ring_size_in_subprocess(env_value):
+    """Capacity is resolved at import; probe it in a fresh interpreter."""
+    import os
+    env = dict(os.environ)
+    env.pop("MPIBT_EVENT_BUFFER", None)
+    if env_value is not None:
+        env["MPIBT_EVENT_BUFFER"] = env_value
+    code = (
+        "import warnings; warnings.simplefilter('ignore')\n"
+        "from mpi_blockchain_tpu.telemetry import events\n"
+        "for i in range(events.EVENT_RING_SIZE + 5):\n"
+        "    events._ring.append({'n': i})\n"
+        "print(events.EVENT_RING_SIZE, len(events.recent_events()))\n")
+    proc = subprocess.run([sys.executable, "-c", code], cwd=ROOT,
+                          env=env, capture_output=True, text=True,
+                          timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    return tuple(int(x) for x in proc.stdout.split())
+
+
+def test_event_buffer_env_overrides_capacity():
+    assert _ring_size_in_subprocess("5") == (5, 5)
+
+
+def test_event_buffer_default_capacity():
+    assert _ring_size_in_subprocess(None) == (2048, 2048)
+
+
+@pytest.mark.parametrize("bad", ["zero", "-3", "0"])
+def test_event_buffer_invalid_value_falls_back(bad):
+    assert _ring_size_in_subprocess(bad) == (2048, 2048)
