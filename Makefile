@@ -4,9 +4,9 @@
 PY ?= python3
 SHELL := /bin/bash   # tier1 uses pipefail/PIPESTATUS
 
-.PHONY: check lint metrics-smoke forensics-smoke tier1 core clean
+.PHONY: check lint metrics-smoke forensics-smoke perf-smoke tier1 core clean
 
-check: lint metrics-smoke forensics-smoke tier1
+check: lint metrics-smoke forensics-smoke perf-smoke tier1
 
 # chainlint: binding contract, header layout, JAX purity, sanitizer matrix.
 lint:
@@ -51,6 +51,14 @@ forensics-smoke:
 	      len(t['traceEvents'])))" || \
 	    { echo "forensics-smoke: assertions failed"; rm -rf $$tmp; exit 1; }; \
 	rm -rf $$tmp
+
+# Perfwatch smoke: serve a faulted instrumented run, scrape /metrics +
+# /healthz live, then prove the regression sentinel flags an injected
+# 20% drop and passes within-spread noise (the merge-gate contract).
+perf-smoke:
+	env JAX_PLATFORMS=cpu $(PY) -m mpi_blockchain_tpu.perfwatch smoke \
+	    2>/dev/null || { echo "perf-smoke: failed"; exit 1; }; \
+	echo "perf-smoke: ok"
 
 # Tier-1 verify, verbatim from ROADMAP.md.
 tier1:

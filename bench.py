@@ -45,6 +45,12 @@ sys.path.insert(0, str(REPO))
 
 CACHE_PATH = REPO / "BENCH_CACHE.json"
 
+# Every successful run also appends its fresh sections to the perfwatch
+# history (append-only JSONL), so the regression sentinel accumulates a
+# trajectory with no manual steps. --no-record opts out; a recording
+# failure never fails the bench (the measurement is the product).
+HISTORY_PATH = REPO / "PERF_HISTORY.jsonl"
+
 # The pinned round-1 8-rank CPU baseline (mpirun -np 8 stand-in, BASELINE.md
 # measurement matrix). The headline vs_baseline divides by THIS constant so
 # the field is comparable across rounds; the same-run CPU sample (whose
@@ -380,14 +386,44 @@ def _run_roofline_section(measured_mhs: float) -> tuple[dict, str | None]:
                               "MBT_ROOFLINE_MHS": str(measured_mhs)})
 
 
+# ---- perfwatch history ------------------------------------------------------
+
+def _record_history(fresh: dict, history_path) -> None:
+    """Appends this run's FRESH section payloads (never cached re-reports)
+    to the perfwatch history. Best-effort: the bench record must survive
+    a broken history file."""
+    try:
+        from mpi_blockchain_tpu.perfwatch.history import HistoryStore
+
+        store = HistoryStore(history_path)
+        for section, payload in fresh.items():
+            store.record(section, payload, source="bench.py")
+    except Exception as e:
+        print(f"perfwatch record failed: {e}", file=sys.stderr)
+
+
 # ---- assembly ---------------------------------------------------------------
 
-def main() -> int:
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+
     from mpi_blockchain_tpu.bench_lib import bench_cpu
+
+    parser = argparse.ArgumentParser(prog="bench.py")
+    parser.add_argument("--no-record", action="store_true",
+                        help="do not append this run's fresh sections to "
+                             "the perfwatch history")
+    parser.add_argument("--history", metavar="PATH", default=None,
+                        help=f"perfwatch history JSONL "
+                             f"(default {HISTORY_PATH.name})")
+    # No sys.argv fallback: tests drive main() directly under pytest,
+    # whose own argv must not leak in; the __main__ guard passes argv.
+    args = parser.parse_args([] if argv is None else argv)
 
     cpu = bench_cpu(seconds=2.0, n_miners=8)
     sharded, sharded_err = _run_sharded_section()
     dev, dev_err = _run_device_section()
+    fresh: dict = {"cpu_np8": cpu}
 
     detail: dict = {"cpu_np8": _round_floats(cpu)}
     if dev_err:
@@ -406,6 +442,7 @@ def main() -> int:
     sweep = dev.get("sweep")
     if sweep is not None and dev.get("platform") != "cpu":
         _cache_store("sweep", sweep)
+        fresh["sweep"] = sweep
         source = "fresh"
     else:
         if sweep is not None:  # device child fell back to host CPU platform
@@ -418,6 +455,7 @@ def main() -> int:
         if section in dev:
             detail[section] = dev[section]
             _cache_store(section, dev[section])
+            fresh[section] = dev[section]
         elif f"{section}_error" in dev:
             detail[section] = {"error": dev[f"{section}_error"]}
         else:
@@ -432,6 +470,7 @@ def main() -> int:
         if "utilization" in util:
             detail["utilization"] = util["utilization"]
             _cache_store("utilization", util["utilization"])
+            fresh["utilization"] = util["utilization"]
         else:
             cached_util = _cached("utilization")
             if cached_util:
@@ -444,6 +483,7 @@ def main() -> int:
     chain = dev.get("chain")
     if chain is not None:
         _cache_store("chain", chain)
+        fresh["chain"] = chain
     elif "chain_error" in dev:
         detail["chain_1000_diff24"] = {"error": dev["chain_error"]}
     else:
@@ -473,6 +513,9 @@ def main() -> int:
         value = cpu["hashes_per_sec_per_rank"]
         vs = 1.0 / 8.0
 
+    if not args.no_record:
+        _record_history(fresh, args.history or HISTORY_PATH)
+
     print(json.dumps({
         "metric": "hashes_per_sec_per_chip",
         "value": round(value),
@@ -485,4 +528,4 @@ def main() -> int:
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(main(sys.argv[1:]))

@@ -83,19 +83,14 @@ def count_tile_ops(difficulty_bits: int = 24) -> dict:
 
 
 def roofline(measured_mhs: float = 971.8) -> dict:
+    # The peak/utilization closed form is formalized in
+    # perfwatch.attribution (stdlib-only, shared with the regression
+    # sentinel); this experiment contributes the traced op census.
+    from mpi_blockchain_tpu.perfwatch.attribution import utilization
+
     census = count_tile_ops()
-    clock_hz = 197e12 / (4 * 128 * 128 * 2)          # ~1.5 GHz from MXU peak
-    vpu_peak = 8 * 128 * 4 * clock_hz                # lanes x ALUs x clock
-    alu = census["alu_ops_per_nonce"]
-    demand = measured_mhs * 1e6 * alu
-    return {
-        **census,
-        "measured_mhs": measured_mhs,
-        "v5e_clock_ghz": round(clock_hz / 1e9, 3),
-        "vpu_peak_u32_tops": round(vpu_peak / 1e12, 2),
-        "alu_demand_tops": round(demand / 1e12, 2),
-        "vpu_utilization_pct": round(100 * demand / vpu_peak, 1),
-    }
+    return {**census,
+            **utilization(measured_mhs * 1e6, census["alu_ops_per_nonce"])}
 
 
 if __name__ == "__main__":

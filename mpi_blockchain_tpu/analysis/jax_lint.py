@@ -47,7 +47,8 @@ HOST_CALLBACK_NAMES = {"pure_callback", "io_callback", "host_callback"}
 # The telemetry public API (mpi_blockchain_tpu/telemetry): bare-name calls
 # to these, or any call on a module path containing 'telemetry', are host
 # metric/span work and must stay outside the jit boundary (JAX006).
-TELEMETRY_FUNCS = {"counter", "gauge", "histogram", "span", "emit_event"}
+TELEMETRY_FUNCS = {"counter", "gauge", "histogram", "heartbeat", "span",
+                   "emit_event"}
 HOST_SYNC_METHODS = {"item", "tolist", "block_until_ready", "copy_to_host",
                      "__array__"}
 # Calls that trace a function argument -> which positional slots hold it.

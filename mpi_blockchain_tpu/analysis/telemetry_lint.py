@@ -1,4 +1,4 @@
-"""TEL rules — causal-stamp discipline on the simulation bus.
+"""TEL rules — telemetry discipline: causal stamps + metric naming.
 
 The forensics subsystem can only merge per-node logs into one causal
 order if every sim-bus event carries a Lamport stamp and a node id.
@@ -12,8 +12,20 @@ a future edit that emits a bus event through the raw JSON-lines stream
           literal at all. Route sim-bus events through
           ``CausalLog.record`` (telemetry/causal.py), which stamps both.
 
-Scope: ``mpi_blockchain_tpu/simulation.py`` (the bus surface). Override
-key ``sim_py`` redirects it — the drift-fixture test seam.
+  TEL002  a registry metric registered under a name that violates the
+          documented naming/unit-suffix convention (docs/perfwatch.md):
+          counters must end ``_total``; histograms must carry a unit
+          suffix (``_ms``/``_seconds``/... or a documented count unit);
+          no gauge/histogram may end ``_total``, ``_count`` or ``_sum``
+          (``_count``/``_sum`` collide with the summary sample names the
+          Prometheus exporter appends, ``_total`` masquerades as a
+          counter to any dashboard). Only statically-known (literal
+          string) names are checked — f-string families like
+          ``sim_group_{field}`` are the call site's responsibility.
+
+Scope: TEL001 over ``mpi_blockchain_tpu/simulation.py`` (the bus
+surface; override key ``sim_py``); TEL002 over every ``.py`` in the
+package (override key ``telemetry_files`` — the drift-fixture seam).
 """
 from __future__ import annotations
 
@@ -24,6 +36,14 @@ from . import Finding
 from .jax_lint import _call_name
 
 REQUIRED_FIELDS = ("lamport", "node")
+
+# TEL002: unit suffixes a histogram name may carry. Time/size units plus
+# the repo's documented count units (reorg depth in blocks).
+HISTOGRAM_UNIT_SUFFIXES = ("_ms", "_us", "_ns", "_s", "_seconds", "_bytes",
+                           "_depth", "_blocks", "_pct")
+# Reserved endings: _count/_sum are appended by the Prometheus summary
+# renderer; _total is the counter convention.
+RESERVED_SUFFIXES = ("_total", "_count", "_sum")
 
 
 def _literal_str_keys(node: ast.expr) -> set[str] | None:
@@ -45,19 +65,92 @@ def _literal_str_keys(node: ast.expr) -> set[str] | None:
     return None
 
 
+def _metric_name_arg(node: ast.Call) -> str | None:
+    """The literal metric name of a counter/gauge/histogram call, or None
+    when it is not statically known (variable, f-string family)."""
+    arg = node.args[0] if node.args else None
+    if arg is None:
+        for kw in node.keywords:
+            if kw.arg == "name":
+                arg = kw.value
+                break
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return arg.value
+    return None
+
+
+def _check_metric_name(kind: str, name: str) -> str | None:
+    """TEL002 violation message for one (metric kind, literal name)."""
+    if kind == "counter":
+        if not name.endswith("_total"):
+            return (f"counter {name!r} must end '_total' "
+                    f"(the monotonic-counter convention)")
+        return None
+    bad = next((s for s in RESERVED_SUFFIXES if name.endswith(s)), None)
+    if bad:
+        return (f"{kind} {name!r} must not end {bad!r} — reserved for "
+                f"{'counters' if bad == '_total' else 'summary samples'}")
+    if kind == "histogram" and not name.endswith(HISTOGRAM_UNIT_SUFFIXES):
+        return (f"histogram {name!r} lacks a unit suffix "
+                f"{HISTOGRAM_UNIT_SUFFIXES}")
+    return None
+
+
+def _package_py_files(root: pathlib.Path) -> list[pathlib.Path]:
+    pkg = root / "mpi_blockchain_tpu"
+    return sorted(p for p in pkg.rglob("*.py") if "__pycache__" not in p.parts)
+
+
+def _run_naming_lint(root: pathlib.Path, files) -> list[Finding]:
+    """TEL002 over every metric registration with a literal name."""
+    findings: list[Finding] = []
+    for path in files:
+        rel = (str(path.relative_to(root)) if path.is_relative_to(root)
+               else str(path))
+        try:
+            tree = ast.parse(path.read_text(), filename=str(path))
+        except SyntaxError as e:
+            findings.append(Finding(rel, e.lineno or 1, "TEL000",
+                                    f"syntax error: {e.msg}"))
+            continue
+        except OSError:
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            kind = _call_name(node)
+            if kind not in ("counter", "gauge", "histogram"):
+                continue
+            name = _metric_name_arg(node)
+            if name is None:
+                continue
+            msg = _check_metric_name(kind, name)
+            if msg:
+                findings.append(Finding(
+                    rel, node.lineno, "TEL002",
+                    f"{msg}; see the naming convention in "
+                    f"docs/perfwatch.md"))
+    return findings
+
+
 def run_telemetry_lint(root: pathlib.Path, overrides=None,
                        notes=None) -> list[Finding]:
     overrides = overrides or {}
+    tel_files = overrides.get("telemetry_files")
+    if tel_files is None:
+        tel_files = _package_py_files(root)
+    elif isinstance(tel_files, (str, pathlib.Path)):
+        tel_files = [pathlib.Path(tel_files)]
+    findings: list[Finding] = list(_run_naming_lint(root, tel_files))
     sim_py = overrides.get(
         "sim_py", root / "mpi_blockchain_tpu" / "simulation.py")
-    findings: list[Finding] = []
     rel = (str(sim_py.relative_to(root)) if sim_py.is_relative_to(root)
            else str(sim_py))
     try:
         tree = ast.parse(sim_py.read_text(), filename=str(sim_py))
     except SyntaxError as e:
-        return [Finding(rel, e.lineno or 1, "TEL000",
-                        f"syntax error: {e.msg}")]
+        return findings + [Finding(rel, e.lineno or 1, "TEL000",
+                                   f"syntax error: {e.msg}")]
     for node in ast.walk(tree):
         if not isinstance(node, ast.Call) or \
                 _call_name(node) != "emit_event":

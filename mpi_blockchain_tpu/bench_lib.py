@@ -14,7 +14,7 @@ import threading
 import time
 
 from . import core
-from .telemetry import counter, emit_event, gauge
+from .telemetry import counter, emit_event, gauge, heartbeat
 from .telemetry.events import env_number
 from .telemetry.spans import span
 
@@ -44,6 +44,11 @@ def _device_init_phase(name: str, timeout_s: float | None = None):
 
     timeout_s = (DEVICE_INIT_PHASE_TIMEOUT_S if timeout_s is None
                  else timeout_s)
+    # One heartbeat stamp at phase ENTRY: a phase that wedges leaves the
+    # gauge stale, so a live /healthz scrape turns unhealthy while the
+    # hang is still in flight (the watchdog/flight-recorder path below
+    # covers the post-mortem).
+    heartbeat("bench_heartbeat").inc()
     t0 = time.perf_counter()
 
     def _hang() -> None:
@@ -88,11 +93,13 @@ def bench_cpu(seconds: float = 3.0, n_miners: int = 1,
         tried = 0
         deadline = time.perf_counter() + seconds
         base = rank * (1 << 28)
+        hb = heartbeat("bench_heartbeat")
         while time.perf_counter() < deadline:
             _, t = core.cpu_search(_HEADER, base, chunk,
                                    _IMPOSSIBLE_DIFFICULTY)
             tried += t
             hashes_c.inc(t)
+            hb.inc()
             base += chunk
         return tried
 
@@ -161,6 +168,7 @@ def bench_tpu(seconds: float = 5.0, batch_pow2: int = 28,
     if depth is None:  # keep the in-flight queue under ~1s of compute
         depth = 16 if batch_pow2 < 26 else 4
     pending: list = []
+    hb = heartbeat("bench_heartbeat")
     t0 = time.perf_counter()
     tried = 0
     while time.perf_counter() - t0 < seconds:
@@ -168,6 +176,7 @@ def bench_tpu(seconds: float = 5.0, batch_pow2: int = 28,
         tried += round_size
         if len(pending) >= depth:
             int(pending.pop(0)[0])
+            hb.inc()
     for r in pending:
         int(r[0])
     wall = time.perf_counter() - t0

@@ -55,6 +55,11 @@ def _add_metrics_dump_arg(p: argparse.ArgumentParser) -> None:
                         "(uncaught exception, sim non-convergence) dump "
                         "events + causal logs + metrics snapshot to PATH "
                         "(env MPIBT_FLIGHT_RECORDER also arms it)")
+    p.add_argument("--serve-metrics", metavar="PORT", type=int, default=None,
+                   help="serve /metrics, /healthz, /events over HTTP for "
+                        "the duration of the run (0 = ephemeral port, "
+                        "announced on stderr; env MPIBT_METRICS_PORT also "
+                        "enables it)")
 
 
 def _config_from(args) -> MinerConfig:
@@ -416,6 +421,29 @@ def main(argv: list[str] | None = None) -> int:
         from .telemetry import flight_recorder
         flight_recorder.install(fr_path)
         flight_recorder.register_context(command=args.command)
+    metrics_port = getattr(args, "serve_metrics", None)
+    if metrics_port is None and hasattr(args, "serve_metrics"):
+        # Env fallback only for the subcommands that take the flag
+        # (mine/sim/bench): verify/info have no run to observe, and an
+        # exported MPIBT_METRICS_PORT must not surprise-bind ports there.
+        from .telemetry.events import env_number
+        metrics_port = env_number("MPIBT_METRICS_PORT", None, cast=int,
+                                  minimum=0)
+    metrics_server = None
+    if metrics_port is not None:
+        from .perfwatch.server import MetricsServer
+        metrics_server = MetricsServer(port=metrics_port)
+        try:
+            port = metrics_server.start()
+        except (OSError, OverflowError) as e:
+            # A taken (or out-of-range) port must not kill the run it
+            # was meant to observe.
+            print(f"serve-metrics failed: {e}", file=sys.stderr)
+            metrics_server = None
+        else:
+            print(f"serving metrics on http://127.0.0.1:{port} "
+                  f"(/metrics /healthz /events)", file=sys.stderr,
+                  flush=True)
     try:
         return args.fn(args)
     except ConfigError as e:
@@ -439,6 +467,12 @@ def main(argv: list[str] | None = None) -> int:
                 dump_metrics(args.metrics_dump)
             except OSError as e:
                 print(f"metrics-dump failed: {e}", file=sys.stderr)
+        # The endpoint must release its port on EVERY exit path — an
+        # uncaught exception passes through here on its way to the
+        # flight-recorder excepthook, and a wedged scrape thread is
+        # daemonic so close() cannot hang the exit.
+        if metrics_server is not None:
+            metrics_server.close()
 
 
 if __name__ == "__main__":

@@ -31,7 +31,7 @@ import numpy as np
 
 from .. import core
 from ..config import MAX_EXTRA_NONCE, ConfigError, extend_payload
-from ..telemetry import counter
+from ..telemetry import counter, heartbeat
 from ..telemetry.spans import span
 from ..ops.sha256_jnp import (IV, _bswap32, compress,
                               sha256d_words_from_midstate)
@@ -218,6 +218,9 @@ class FusedMiner:
             counter("device_dispatches_total",
                     help="jit'd multi-round search programs dispatched",
                     backend="tpu-fused").inc()
+            # Heartbeat per dispatch: the fused loop's only host-side
+            # progress point — /healthz watches the last_set age.
+            heartbeat("miner_heartbeat").set(height)
             batches.append((height, payloads, nonces))
             height += k
             remaining -= k

@@ -14,7 +14,7 @@ from typing import Callable
 from .. import core
 from ..backend import MinerBackend, backend_from_config
 from ..config import MAX_EXTRA_NONCE, MinerConfig, extend_payload
-from ..telemetry import counter, histogram
+from ..telemetry import counter, heartbeat, histogram
 from ..telemetry.spans import span
 from ..utils.logging import block_logger
 
@@ -71,6 +71,9 @@ class Miner:
                 counter("mining_rounds_total",
                         help="backend sweep rounds issued",
                         backend=backend).inc()
+                # One stamp per sweep round, so a wedged backend stalls
+                # the /healthz watchdog.
+                heartbeat("miner_heartbeat").set(self.node.height)
                 counter("hashes_tried_total",
                         help="nonces evaluated across all sweeps",
                         backend=backend).inc(res.hashes_tried)
@@ -94,6 +97,7 @@ class Miner:
             raise RuntimeError(f"backend returned invalid block at {height}")
         counter("blocks_mined_total", help="blocks mined and appended",
                 backend=backend).inc()
+        heartbeat("miner_heartbeat").set(self.node.height)
         histogram("block_latency_ms",
                   help="wall-clock per mined block (winner latency, ms)",
                   backend=backend).observe(wall_ms)

@@ -1,0 +1,47 @@
+"""perfwatch — live observability + perf-regression sentinel.
+
+The telemetry plane (PR 2) and the forensics layer (PR 3) are both
+dump-on-exit: nothing could be observed while a long mine/sim/bench run
+was in flight, and the perf trajectory in ``BENCH_r0*.json`` /
+``BENCH_CACHE.json`` was watched by no machine — a silent 20% kernel
+regression would merge clean. This package closes both gaps:
+
+* **server** — a stdlib-only threaded HTTP endpoint
+  (``--serve-metrics PORT`` on mine/sim/bench, or env
+  ``MPIBT_METRICS_PORT``) exposing
+
+  - ``/metrics``  the registry's Prometheus snapshot, rendered on demand,
+  - ``/healthz``  liveness + a last-progress-age watchdog over the
+    ``*_heartbeat`` gauges (miner/sim/bench stamp one per unit of
+    progress; a wedged device init or stalled sim goes stale → 503),
+  - ``/events``   the redacted tail of the bounded JSON event ring.
+
+* **history** — an append-only JSONL store of bench payloads keyed by
+  (section, preset/kernel/mesh identity), seeded by importing the
+  existing ``BENCH_r0*.json`` round records and ``BENCH_CACHE.json``.
+
+* **detector** — a spread-aware change detector: a new measurement is a
+  regression when it falls short of the baseline (best prior run for the
+  same key) by more than ``max(threshold_pct, k * spread_pct)`` — the
+  rep-spread already on every official record (``bench_lib.repeat_best``)
+  sets the noise floor, so tunnel jitter does not page and a real 20%
+  kernel drop does.
+
+* **attribution** — the roofline/utilization math that was ad-hoc in
+  ``experiments/roofline.py`` (VPU ops/nonce x rate vs peak TOPS),
+  formalized, plus a span-split attribution (device dispatch vs host
+  tail vs device init) over the PR 2 ``span_seconds`` summaries so a
+  regression is attributed to kernel vs dispatch vs host.
+
+CLI: ``python -m mpi_blockchain_tpu.perfwatch {record,check,report,serve}``
+— ``check`` exits non-zero on a regression, making the observability
+layer a merge gate (``make perf-smoke``, inside ``make check``).
+
+Standard library only; importing this package never pulls in jax.
+Catalogue + math: docs/perfwatch.md.
+"""
+from __future__ import annotations
+
+from .detector import check_history  # noqa: F401
+from .history import HistoryStore  # noqa: F401
+from .server import MetricsServer, active_server  # noqa: F401

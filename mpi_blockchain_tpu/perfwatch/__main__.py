@@ -1,0 +1,318 @@
+"""CLI: python -m mpi_blockchain_tpu.perfwatch {record,check,report,serve}
+
+The perf-regression sentinel as a merge gate:
+
+    # seed the history from the repo's bench round records
+    python -m mpi_blockchain_tpu.perfwatch record --seed-bench-rounds
+
+    # judge the newest entry of every series; exit 1 on a regression
+    python -m mpi_blockchain_tpu.perfwatch check
+
+    # judge a fresh payload WITHOUT recording it (measure -> gate -> record)
+    python -m mpi_blockchain_tpu.perfwatch check --section sweep \\
+        --candidate sweep.json
+
+    # trajectory + roofline + span-attribution report
+    python -m mpi_blockchain_tpu.perfwatch report
+
+    # standalone endpoint (mine/sim/bench embed the same server via
+    # --serve-metrics PORT); serves until interrupted
+    python -m mpi_blockchain_tpu.perfwatch serve --port 0
+
+``smoke`` is the CI shape (``make perf-smoke``): serve a faulted sim,
+scrape /metrics + /healthz live, then run the detector against a
+synthetic history with an injected drop (must flag) and within-spread
+noise (must not).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+from .attribution import attribute_spans, utilization
+from .detector import (DEFAULT_SPREAD_K, DEFAULT_THRESHOLD_PCT,
+                       check_candidate, check_history, regressions)
+from .history import (DEFAULT_HISTORY_NAME, HistoryStore,
+                      SECTION_METRICS, seed_from_bench_rounds)
+
+
+def _repo_root() -> pathlib.Path:
+    return pathlib.Path(__file__).resolve().parent.parent.parent
+
+
+def _store(args) -> HistoryStore:
+    path = (pathlib.Path(args.history) if args.history
+            else _repo_root() / DEFAULT_HISTORY_NAME)
+    return HistoryStore(path)
+
+
+def cmd_record(args) -> int:
+    store = _store(args)
+    out: dict = {"event": "perfwatch_record", "history": str(store.path)}
+    if args.seed_bench_rounds:
+        out.update(seed_from_bench_rounds(store, args.root or _repo_root()))
+    elif args.section and args.payload:
+        try:
+            payload = json.loads(pathlib.Path(args.payload).read_text())
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"perfwatch record: cannot read payload: {e}",
+                  file=sys.stderr)
+            return 2
+        entry = store.record(args.section, payload, source=args.source)
+        if entry is None:
+            print(f"perfwatch record: section {args.section!r} unknown or "
+                  f"payload lacks its metric "
+                  f"{SECTION_METRICS.get(args.section, ('?',))[0]!r}",
+                  file=sys.stderr)
+            return 2
+        out.update(recorded=1, key=entry.key)
+    else:
+        print("perfwatch record: need --seed-bench-rounds or "
+              "--section + --payload", file=sys.stderr)
+        return 2
+    print(json.dumps(out, sort_keys=True))
+    return 0
+
+
+def cmd_check(args) -> int:
+    store = _store(args)
+    if args.candidate:
+        if not args.section:
+            print("perfwatch check: --candidate needs --section",
+                  file=sys.stderr)
+            return 2
+        try:
+            payload = json.loads(pathlib.Path(args.candidate).read_text())
+            findings = [check_candidate(store, args.section, payload,
+                                        threshold_pct=args.threshold_pct,
+                                        k=args.k)]
+        except (OSError, json.JSONDecodeError, ValueError) as e:
+            print(f"perfwatch check: {e}", file=sys.stderr)
+            return 2
+    else:
+        findings = check_history(store, threshold_pct=args.threshold_pct,
+                                 k=args.k)
+    bad = regressions(findings)
+    try:
+        if args.as_json:
+            print(json.dumps({"event": "perfwatch_check",
+                              "history": str(store.path),
+                              "regressions": len(bad),
+                              "findings": [f.to_dict() for f in findings]},
+                             sort_keys=True))
+        else:
+            for f in findings:
+                print(f.render())
+            print(f"perfwatch: {len(bad)} regression(s) across "
+                  f"{len(findings)} series", file=sys.stderr)
+    except BrokenPipeError:
+        # `check | head` truncating the report is the reader's choice;
+        # the GATE verdict below must survive it either way.
+        sys.stderr.close()
+    return 1 if bad else 0
+
+
+def cmd_report(args) -> int:
+    store = _store(args)
+    series = {}
+    for key, entries in sorted(store.by_key().items()):
+        metric, direction = entries[0].metric
+        vals = [e.value for e in entries]
+        series[key] = {
+            "metric": metric, "direction": direction,
+            "count": len(entries),
+            "latest": vals[-1], "latest_at": entries[-1].recorded_at,
+            "best": (max(vals) if direction == "higher"
+                     else min(vals) if direction == "lower" else None),
+            "trajectory": [round(v, 3) for v in vals],
+        }
+    report = {"event": "perfwatch_report", "history": str(store.path),
+              "series": series,
+              "findings": [f.to_dict() for f in check_history(
+                  store, threshold_pct=args.threshold_pct, k=args.k)]}
+    # Roofline: latest sweep rate against the latest recorded op census
+    # (only when one carries the census — a hand-recorded utilization
+    # payload may hold just the headline pct).
+    sweeps = store.entries("sweep")
+    census = [e for e in store.entries("utilization")
+              if e.payload.get("alu_ops_per_nonce")]
+    if sweeps and census:
+        report["roofline"] = utilization(
+            sweeps[-1].payload["hashes_per_sec_per_chip"],
+            int(census[-1].payload["alu_ops_per_nonce"]))
+    print(json.dumps(report, sort_keys=True))
+    return 0
+
+
+def cmd_serve(args) -> int:
+    from .server import MetricsServer
+
+    srv = MetricsServer(port=args.port, host=args.host,
+                        stall_s=args.stall_s)
+    port = srv.start()
+    print(json.dumps({"event": "perfwatch_serve", "host": args.host,
+                      "port": port,
+                      "endpoints": ["/metrics", "/healthz", "/events"]}),
+          flush=True)
+    try:
+        import threading
+        threading.Event().wait()            # serve until interrupted
+    except KeyboardInterrupt:
+        pass
+    finally:
+        srv.close()
+    return 0
+
+
+def cmd_smoke(args) -> int:
+    """The make perf-smoke gate, in-process (no ports leak on failure)."""
+    import threading
+    import urllib.request
+
+    from ..telemetry import clear_events, reset
+    from ..telemetry.__main__ import run_instrumented
+    from .server import MetricsServer
+
+    reset()
+    clear_events()
+    srv = MetricsServer(port=0, stall_s=60.0)
+    try:
+        srv.start()
+        worker = threading.Thread(
+            target=run_instrumented,
+            kwargs={"steps": 2, "sim_target": 4, "partition_steps": 12,
+                    "drop_rate_pct": 25},
+            daemon=True)
+        worker.start()
+        worker.join(timeout=120)
+        if worker.is_alive():
+            print("perf-smoke: instrumented run wedged", file=sys.stderr)
+            return 1
+        with urllib.request.urlopen(srv.url("/metrics"), timeout=10) as r:
+            metrics = r.read().decode()
+        with urllib.request.urlopen(srv.url("/healthz"), timeout=10) as r:
+            health = json.loads(r.read().decode())
+        for needle in ("mining_rounds_total", "sim_heartbeat",
+                       "miner_heartbeat", "# TYPE"):
+            if needle not in metrics:
+                print(f"perf-smoke: /metrics missing {needle!r}",
+                      file=sys.stderr)
+                return 1
+        if not health["healthy"]:
+            print(f"perf-smoke: /healthz unhealthy: {health}",
+                  file=sys.stderr)
+            return 1
+    finally:
+        srv.close()
+
+    # Detector leg: an injected 20% drop must flag, within-spread noise
+    # must not — against a synthetic 2-entry history per series.
+    import tempfile
+    with tempfile.TemporaryDirectory() as tmp:
+        store = HistoryStore(pathlib.Path(tmp) / "hist.jsonl")
+        base = {"kernel": "pallas", "batch_pow2": 28, "n_miners": 1,
+                "spread_pct": 0.5, "reps": 2}
+        store.record("sweep", {**base, "hashes_per_sec_per_chip": 970e6},
+                     source="smoke")
+        store.record("sweep", {**base, "hashes_per_sec_per_chip": 776e6},
+                     source="smoke")       # -20%: must regress
+        flagged = regressions(check_history(store))
+        store2 = HistoryStore(pathlib.Path(tmp) / "hist2.jsonl")
+        store2.record("sweep", {**base, "hashes_per_sec_per_chip": 970e6},
+                      source="smoke")
+        store2.record("sweep", {**base, "hashes_per_sec_per_chip": 967e6},
+                      source="smoke")      # -0.3%: within spread
+        clean = regressions(check_history(store2))
+        if len(flagged) != 1 or clean:
+            print(f"perf-smoke: detector wrong (flagged={len(flagged)}, "
+                  f"clean={len(clean)})", file=sys.stderr)
+            return 1
+    attribution = attribute_spans()
+    print(json.dumps({"event": "perfwatch_smoke", "ok": True,
+                      "healthz": health["status"],
+                      "metrics_lines": len(metrics.splitlines()),
+                      "span_attribution_dominant": attribution["dominant"]},
+                     sort_keys=True))
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m mpi_blockchain_tpu.perfwatch",
+        description="live metrics endpoint + perf-regression sentinel")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    def _common(p):
+        p.add_argument("--history", metavar="PATH", default=None,
+                       help=f"history JSONL (default: repo-root "
+                            f"{DEFAULT_HISTORY_NAME})")
+
+    p_rec = sub.add_parser("record", help="append a measurement / seed "
+                                          "from BENCH_r0*.json")
+    _common(p_rec)
+    p_rec.add_argument("--section", choices=sorted(SECTION_METRICS))
+    p_rec.add_argument("--payload", metavar="FILE",
+                       help="JSON payload file (a bench section payload)")
+    p_rec.add_argument("--source", default="cli")
+    p_rec.add_argument("--seed-bench-rounds", action="store_true",
+                       help="import BENCH_r0*.json + BENCH_CACHE.json")
+    p_rec.add_argument("--root", default=None,
+                       help="where the BENCH_r0*.json files live "
+                            "(default: repo root)")
+    p_rec.set_defaults(fn=cmd_record)
+
+    p_chk = sub.add_parser("check", help="judge newest entries (or a "
+                                         "--candidate payload); exit 1 "
+                                         "on regression")
+    _common(p_chk)
+    p_chk.add_argument("--threshold-pct", type=float,
+                       default=DEFAULT_THRESHOLD_PCT,
+                       help="minimum drop considered a regression "
+                            "(default %(default)s)")
+    p_chk.add_argument("--k", type=float, default=DEFAULT_SPREAD_K,
+                       help="spread multiplier: allowed = max(threshold, "
+                            "k*spread_pct) (default %(default)s)")
+    p_chk.add_argument("--section", choices=sorted(SECTION_METRICS))
+    p_chk.add_argument("--candidate", metavar="FILE",
+                       help="judge this payload against history without "
+                            "recording it")
+    p_chk.add_argument("--json", action="store_true", dest="as_json")
+    p_chk.set_defaults(fn=cmd_check)
+
+    p_rep = sub.add_parser("report", help="trajectory + roofline + "
+                                          "span-attribution JSON report")
+    _common(p_rep)
+    p_rep.add_argument("--threshold-pct", type=float,
+                       default=DEFAULT_THRESHOLD_PCT)
+    p_rep.add_argument("--k", type=float, default=DEFAULT_SPREAD_K)
+    p_rep.set_defaults(fn=cmd_report)
+
+    p_srv = sub.add_parser("serve", help="standalone metrics endpoint "
+                                         "(until interrupted)")
+    p_srv.add_argument("--port", type=int, default=0,
+                       help="0 = ephemeral (announced on stdout)")
+    p_srv.add_argument("--host", default="127.0.0.1")
+    p_srv.add_argument("--stall-s", type=float, default=None,
+                       help="healthz stall budget (default "
+                            "MPIBT_HEALTHZ_STALL or 30)")
+    p_srv.set_defaults(fn=cmd_serve)
+
+    p_smk = sub.add_parser("smoke", help="the make perf-smoke gate: "
+                                         "live scrape + detector check")
+    p_smk.set_defaults(fn=cmd_smoke)
+
+    args = parser.parse_args(argv)
+    try:
+        return args.fn(args)
+    except BrokenPipeError:
+        # `perfwatch check | head` is normal usage for a multi-line
+        # report; a closed pipe is the reader's choice, not our failure
+        # — and must not read as a regression (exit stays 0).
+        sys.stderr.close()
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

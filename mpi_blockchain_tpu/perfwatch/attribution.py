@@ -1,0 +1,109 @@
+"""Roofline utilization + span-split attribution for perf findings.
+
+Two questions a regression report must answer beyond "the number fell":
+
+1. **How far from the hardware ceiling is the measured rate?** The
+   arithmetic lived ad-hoc in ``experiments/roofline.py``; the closed
+   form is here (stdlib only — the op CENSUS still needs jax tracing
+   and stays in the experiment, which now calls back into this module):
+
+   * v5e peak bf16 matmul = 197 TFLOP/s over 4 MXUs of 128x128 MACs at
+     2 FLOPs each  =>  clock ~= 1.5 GHz;
+   * VPU = (8, 128) lanes x 4 independent ALUs per lane
+     =>  peak u32 rate = 8*128*4*clock ~= 6.16e12 ops/s;
+   * utilization = measured_rate * alu_ops_per_nonce / peak.
+
+2. **Which layer ate the time?** The PR 2 spans already split every run
+   into device dispatch (``backend.tpu.dispatch``, ``fused.dispatch``),
+   host tail (``miner.append``, ``backend.tpu.host_tail``,
+   ``backend.cpu.search``) and device init (``bench.device_init``);
+   ``attribute_spans`` folds the ``span_seconds`` summaries into those
+   buckets and names the dominant one — so "sweep dropped 20%" comes
+   attributed to kernel (device-bound, utilization fell), dispatch
+   (init/compile grew), or host (tail grew), instead of a bare number.
+"""
+from __future__ import annotations
+
+# ---- VPU roofline closed form (public v5e numbers) ------------------------
+
+V5E_PEAK_BF16_MATMUL_FLOPS = 197e12
+MXU_COUNT = 4
+MXU_MAC_DIM = 128            # 128x128 MACs, 2 FLOPs each
+VPU_SUBLANES = 8
+VPU_LANES = 128
+VPU_ALUS_PER_LANE = 4
+
+
+def v5e_clock_hz() -> float:
+    """Core clock backed out of the public MXU peak."""
+    return V5E_PEAK_BF16_MATMUL_FLOPS / (
+        MXU_COUNT * MXU_MAC_DIM * MXU_MAC_DIM * 2)
+
+
+def vpu_peak_u32_ops_per_s() -> float:
+    """Peak u32 ALU rate: lanes x sublanes x ALUs x clock."""
+    return VPU_SUBLANES * VPU_LANES * VPU_ALUS_PER_LANE * v5e_clock_hz()
+
+
+def utilization(measured_hashes_per_s: float,
+                alu_ops_per_nonce: int) -> dict:
+    """The roofline position of a measured sweep rate, given the traced
+    ALU-op census (``experiments/roofline.py:count_tile_ops``)."""
+    peak = vpu_peak_u32_ops_per_s()
+    demand = measured_hashes_per_s * alu_ops_per_nonce
+    return {
+        "measured_mhs": measured_hashes_per_s / 1e6,
+        "alu_ops_per_nonce": alu_ops_per_nonce,
+        "v5e_clock_ghz": round(v5e_clock_hz() / 1e9, 3),
+        "vpu_peak_u32_tops": round(peak / 1e12, 2),
+        "alu_demand_tops": round(demand / 1e12, 2),
+        "vpu_utilization_pct": round(100 * demand / peak, 1),
+    }
+
+
+# ---- span-split attribution ----------------------------------------------
+
+# span name -> bucket. Unlisted spans fold into "other" (they still
+# count toward the total so fractions stay honest).
+SPAN_BUCKETS = {
+    "backend.tpu.dispatch": "device",
+    "fused.dispatch": "device",
+    "backend.tpu.host_tail": "host",
+    "backend.cpu.search": "host",
+    "miner.append": "host",
+    "bench.device_init": "init",
+}
+
+
+def attribute_spans(registry=None) -> dict:
+    """Folds the ``span_seconds`` summaries of a registry into
+    device / host / init / other buckets.
+
+    Returns {"buckets": {bucket: {"seconds", "fraction", "spans"}},
+    "total_s", "dominant"} — ``dominant`` is the regression attribution:
+    ``device``-dominant means the kernel itself (check utilization),
+    ``init`` means dispatch/compile overhead grew, ``host`` means the
+    append/oracle tail. Empty registries return dominant None.
+    """
+    from ..telemetry import default_registry
+
+    reg = registry if registry is not None else default_registry()
+    buckets: dict[str, dict] = {}
+    total = 0.0
+    for m in reg.metrics():
+        if m.name != "span_seconds" or m.kind != "histogram":
+            continue
+        labels = dict(m.labels)
+        span_name = labels.get("span", "")
+        bucket = SPAN_BUCKETS.get(span_name, "other")
+        b = buckets.setdefault(bucket, {"seconds": 0.0, "spans": {}})
+        b["seconds"] += m.sum
+        b["spans"][span_name] = round(m.sum, 6)
+        total += m.sum
+    for b in buckets.values():
+        b["fraction"] = round(b["seconds"] / total, 4) if total else 0.0
+        b["seconds"] = round(b["seconds"], 6)
+    dominant = (max(buckets, key=lambda k: buckets[k]["seconds"])
+                if buckets else None)
+    return {"buckets": buckets, "total_s": round(total, 6),
+            "dominant": dominant}

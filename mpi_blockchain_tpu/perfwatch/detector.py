@@ -1,0 +1,144 @@
+"""Spread-aware perf-regression detection over the history store.
+
+For every measurement series (history key) the baseline is the BEST
+prior value — max for higher-is-better metrics (sweep rate), min for
+lower-is-better (chain wall-clock). The newest entry (or an un-recorded
+candidate payload) regresses when it falls short of that baseline by
+more than
+
+    max(threshold_pct, k * spread_pct)
+
+where ``spread_pct`` is the larger of the candidate's and the baseline's
+recorded rep spread (``bench_lib.repeat_best`` puts it on every official
+record). The spread term is the executable form of BASELINE.md's tunnel
+warning: the axon tunnel can inflate or deflate a single run, and the
+best-of-N spread is the measured noise floor for exactly this config —
+a 20% kernel drop on a 0.5%-spread series pages; 8% jitter on a
+12%-spread series does not.
+
+Findings carry the per-series arithmetic so the report is auditable,
+and ``improved`` / ``insufficient-history`` verdicts are reported (not
+just regressions) so a green check is distinguishable from a vacuous
+one.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+from .history import SECTION_METRICS, Entry, HistoryStore, entry_key
+
+DEFAULT_THRESHOLD_PCT = 10.0
+DEFAULT_SPREAD_K = 2.0
+
+# Per-section noise floors that beat the global threshold. The same-run
+# CPU sample load-drifts 0.8-1.8 MH/s on a shared box — BASELINE.md
+# demoted it from the headline for exactly this reason — so its series
+# only gates catastrophic host regressions, not scheduler weather.
+SECTION_FLOOR_PCT = {"cpu_np8": 60.0}
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    key: str
+    section: str
+    metric: str
+    direction: str
+    verdict: str          # "regression" | "ok" | "improved"
+                          # | "insufficient-history"
+    candidate: float | None = None
+    baseline: float | None = None
+    baseline_at: str | None = None
+    delta_pct: float | None = None     # positive = worse, by direction
+    allowed_pct: float | None = None   # max(threshold, k*spread)
+    spread_pct: float | None = None
+
+    def to_dict(self) -> dict:
+        return {k: v for k, v in dataclasses.asdict(self).items()
+                if v is not None}
+
+    def render(self) -> str:
+        if self.verdict == "insufficient-history":
+            return f"{self.key}: insufficient history (1 entry)"
+        arrow = {"regression": "REGRESSION", "improved": "improved",
+                 "ok": "ok"}[self.verdict]
+        return (f"{self.key}: {arrow} {self.metric}={self.candidate:g} "
+                f"vs baseline {self.baseline:g} "
+                f"(delta {self.delta_pct:+.1f}%, positive = worse; "
+                f"allowed {self.allowed_pct:.1f}%)")
+
+
+def _delta_worse_pct(direction: str, baseline: float,
+                     candidate: float) -> float:
+    """How much worse the candidate is than the baseline, in percent of
+    the baseline; negative = better."""
+    scale = max(abs(baseline), 1e-12)
+    if direction == "higher":
+        return 100.0 * (baseline - candidate) / scale
+    return 100.0 * (candidate - baseline) / scale
+
+
+def _judge(key: str, baseline_pool: list[Entry], candidate: Entry,
+           threshold_pct: float, k: float) -> Finding:
+    metric, direction = candidate.metric
+    if not baseline_pool:
+        return Finding(key=key, section=candidate.section, metric=metric,
+                       direction=direction or "",
+                       verdict="insufficient-history",
+                       candidate=candidate.value)
+    pick = max if direction == "higher" else min
+    best = pick(baseline_pool, key=lambda e: e.value)
+    delta = _delta_worse_pct(direction, best.value, candidate.value)
+    spread = max(candidate.spread_pct, best.spread_pct)
+    allowed = max(threshold_pct, k * spread,
+                  SECTION_FLOOR_PCT.get(candidate.section, 0.0))
+    verdict = ("regression" if delta > allowed
+               else "improved" if delta < 0 else "ok")
+    return Finding(key=key, section=candidate.section, metric=metric,
+                   direction=direction, verdict=verdict,
+                   candidate=candidate.value, baseline=best.value,
+                   baseline_at=best.recorded_at,
+                   delta_pct=round(delta, 2),
+                   allowed_pct=round(allowed, 2),
+                   spread_pct=round(spread, 2))
+
+
+def check_history(store: HistoryStore,
+                  threshold_pct: float = DEFAULT_THRESHOLD_PCT,
+                  k: float = DEFAULT_SPREAD_K) -> list[Finding]:
+    """Judges the NEWEST entry of every series against the best of the
+    rest. Newest by ``recorded_at`` (ISO-8601 Z strings sort
+    lexicographically; the stable sort keeps file order for ties), NOT
+    by file position — a late backfill (``record --seed-bench-rounds``
+    after live appends) lands at the end of the file but carries its
+    historical timestamp, and must become baseline, not candidate.
+    Series whose section has direction None are skipped."""
+    findings: list[Finding] = []
+    for key, entries in sorted(store.by_key().items()):
+        if entries[0].metric[1] is None:
+            continue
+        *prior, newest = sorted(entries, key=lambda e: e.recorded_at)
+        findings.append(_judge(key, prior, newest, threshold_pct, k))
+    return findings
+
+
+def check_candidate(store: HistoryStore, section: str, payload: dict,
+                    threshold_pct: float = DEFAULT_THRESHOLD_PCT,
+                    k: float = DEFAULT_SPREAD_K) -> Finding:
+    """Judges an un-recorded payload (the merge-gate shape: measure,
+    check, only record when accepted) against the FULL history of its
+    series."""
+    spec = SECTION_METRICS.get(section)
+    if spec is None or spec[1] is None:
+        checked = sorted(s for s, (_, d) in SECTION_METRICS.items() if d)
+        raise ValueError(f"section {section!r} is not regression-checked; "
+                         f"have {checked}")
+    if spec[0] not in payload:
+        raise ValueError(f"payload lacks {section!r}'s metric {spec[0]!r}")
+    cand = Entry(section=section, key=entry_key(section, payload),
+                 recorded_at="", source="candidate", payload=dict(payload))
+    pool = [e for e in store.entries(section) if e.key == cand.key]
+    return _judge(cand.key, pool, cand, threshold_pct, k)
+
+
+def regressions(findings: list[Finding]) -> list[Finding]:
+    return [f for f in findings if f.verdict == "regression"]

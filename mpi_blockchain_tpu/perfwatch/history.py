@@ -1,0 +1,290 @@
+"""Append-only JSONL perf history, keyed by (section, config identity).
+
+One line per recorded measurement:
+
+    {"section": "sweep", "key": "sweep/pallas/b28/m1",
+     "recorded_at": "2026-08-03T12:00:00Z", "source": "bench.py",
+     "payload": {...the bench payload verbatim...}}
+
+The payload is stored verbatim (spread_pct, reps, tip hashes and all) so
+the detector can be spread-aware and a future reader can re-derive
+anything; the ``key`` collapses the identity fields (preset / kernel /
+mesh / batch / miners) so a pallas 2^28 sweep is never compared against
+a jnp 2^22 one.
+
+Sections and their headline metric (direction matters — ``chain`` is a
+wall-clock, lower is better):
+
+    sweep           hashes_per_sec_per_chip   higher
+    chain           wall_s                    lower
+    tpu_single      hashes_per_sec            higher
+    sharded_pallas  blocks_per_sec            higher
+    cpu_np8         hashes_per_sec            higher
+    utilization     (recorded, never checked: derived from sweep)
+
+Seeding: ``seed_from_bench_rounds`` imports the repo's existing
+``BENCH_r0*.json`` round records (fresh measurements only — ``cached``
+payloads are re-reports of an earlier fresh run) and ``BENCH_CACHE.json``
+(which carries ``measured_at``), de-duplicating on identical metric
+values, so the sentinel starts life already knowing the
+2.83 -> 969.8 MH/s trajectory.
+"""
+from __future__ import annotations
+
+import dataclasses
+import datetime
+import json
+import pathlib
+
+DEFAULT_HISTORY_NAME = "PERF_HISTORY.jsonl"
+
+# section -> (headline metric key, direction). Direction None = record
+# for reference, never regression-checked (utilization is derived from
+# the sweep rate; checking it would double-report every sweep finding).
+SECTION_METRICS: dict[str, tuple[str, str | None]] = {
+    "sweep": ("hashes_per_sec_per_chip", "higher"),
+    "chain": ("wall_s", "lower"),
+    "tpu_single": ("hashes_per_sec", "higher"),
+    "sharded_pallas": ("blocks_per_sec", "higher"),
+    "cpu_np8": ("hashes_per_sec", "higher"),
+    "utilization": ("vpu_utilization_pct", None),
+}
+
+_KEY_FIELDS = ("preset", "kernel", "mesh", "backend")
+
+
+def _utc_now() -> str:
+    return datetime.datetime.now(datetime.timezone.utc).strftime(
+        "%Y-%m-%dT%H:%M:%SZ")
+
+
+def entry_key(section: str, payload: dict) -> str:
+    """Stable identity of a measurement series: section + the config
+    fields that change what is being measured. Payloads missing a field
+    simply omit it (e.g. the trimmed ``chain_1000_diff24`` detail in old
+    round records forms its own — internally consistent — series)."""
+    parts = [section]
+    parts += [str(payload[f]) for f in _KEY_FIELDS if payload.get(f)]
+    for field, tag in (("difficulty_bits", "d"), ("n_blocks", "n"),
+                      ("batch_pow2", "b"), ("n_miners", "m")):
+        if payload.get(field) is not None:
+            parts.append(f"{tag}{payload[field]}")
+    return "/".join(parts)
+
+
+@dataclasses.dataclass(frozen=True)
+class Entry:
+    section: str
+    key: str
+    recorded_at: str
+    source: str
+    payload: dict
+
+    @property
+    def metric(self) -> tuple[str, str | None]:
+        return SECTION_METRICS[self.section]
+
+    @property
+    def value(self) -> float:
+        return float(self.payload[self.metric[0]])
+
+    @property
+    def spread_pct(self) -> float:
+        return float(self.payload.get("spread_pct", 0.0))
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class HistoryStore:
+    """The JSONL file, with append/read/group primitives."""
+
+    def __init__(self, path: str | pathlib.Path):
+        self.path = pathlib.Path(path)
+
+    # ---- write -----------------------------------------------------------
+
+    def record(self, section: str, payload: dict, source: str = "cli",
+               recorded_at: str | None = None,
+               dedupe: bool = False) -> Entry | None:
+        """Appends one measurement. Returns None (and writes nothing)
+        when the section is unknown, the payload lacks the section's
+        headline metric, or ``dedupe`` finds the same value already
+        latest for this key (the seeding path: a ``cached`` payload
+        re-reports an earlier fresh run)."""
+        spec = SECTION_METRICS.get(section)
+        if spec is None or spec[0] not in payload:
+            return None
+        entry = Entry(section=section,
+                      key=entry_key(section, payload),
+                      recorded_at=recorded_at or _utc_now(),
+                      source=source, payload=dict(payload))
+        if dedupe:
+            prior = [e for e in self.entries() if e.key == entry.key]
+            if any(e.value == entry.value for e in prior):
+                return None
+        with self.path.open("a") as f:
+            f.write(json.dumps(entry.to_dict(), sort_keys=True) + "\n")
+        return entry
+
+    # ---- read ------------------------------------------------------------
+
+    def entries(self, section: str | None = None) -> list[Entry]:
+        """All entries, file order (= record order); malformed lines and
+        entries for sections this version no longer knows are skipped —
+        an old history must never crash a new sentinel."""
+        if not self.path.exists():
+            return []
+        out: list[Entry] = []
+        for line in self.path.read_text().splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                d = json.loads(line)
+                e = Entry(section=d["section"], key=d["key"],
+                          recorded_at=d.get("recorded_at", ""),
+                          source=d.get("source", ""),
+                          payload=d["payload"])
+                e.value  # noqa: B018  validates section + metric present
+            except (json.JSONDecodeError, KeyError, TypeError, ValueError):
+                continue
+            if section is not None and e.section != section:
+                continue
+            out.append(e)
+        return out
+
+    def by_key(self, section: str | None = None) -> dict[str, list[Entry]]:
+        grouped: dict[str, list[Entry]] = {}
+        for e in self.entries(section):
+            grouped.setdefault(e.key, []).append(e)
+        return grouped
+
+
+# ---- seeding from the repo's bench records --------------------------------
+
+# bench.py's report nests section payloads under these detail keys.
+_DETAIL_SECTIONS = {
+    "tpu": "sweep",
+    "chain_1000_diff24": "chain",
+    "tpu_single": "tpu_single",
+    "sharded_pallas": "sharded_pallas",
+    "cpu_np8": "cpu_np8",
+    "utilization": "utilization",
+}
+
+
+def _parse_round_report(path: pathlib.Path) -> dict | None:
+    """A BENCH_r0N.json file: {"parsed": {...}} when the driver could
+    parse the run's output, else the raw "tail" whose LAST parseable
+    JSON line is the report (the tail may be truncated at the front)."""
+    try:
+        doc = json.loads(path.read_text())
+    except (OSError, json.JSONDecodeError):
+        return None
+    if isinstance(doc.get("parsed"), dict):
+        return doc["parsed"]
+    report = None
+    for line in str(doc.get("tail", "")).splitlines():
+        line = line.strip()
+        if not (line.startswith("{") and line.endswith("}")):
+            continue
+        try:
+            d = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(d, dict) and "metric" in d:
+            report = d
+    return report
+
+
+def import_bench_report(store: HistoryStore, report: dict, source: str,
+                        dedupe: bool = True,
+                        default_recorded_at: str | None = None) -> int:
+    """Records every fresh section payload of one bench.py report dict.
+    ``cached`` payloads are skipped: they re-report an earlier fresh
+    measurement and would flatten the trajectory. ``default_recorded_at``
+    stamps payloads that carry no ``measured_at`` of their own — the
+    seeding path passes the round file's mtime, so a backfill import
+    lands in the past where it belongs (the detector picks its candidate
+    by recorded_at, not file position)."""
+    detail = report.get("detail", report)
+    if not isinstance(detail, dict):
+        return 0
+    n = 0
+    for key, section in _DETAIL_SECTIONS.items():
+        payload = detail.get(key)
+        if not isinstance(payload, dict) or payload.get("cached"):
+            continue
+        if store.record(section, payload, source=source,
+                        recorded_at=(payload.get("measured_at")
+                                     or default_recorded_at),
+                        dedupe=dedupe):
+            n += 1
+    return n
+
+
+def _parse_iso_z(s) -> datetime.datetime | None:
+    try:
+        return datetime.datetime.strptime(
+            str(s), "%Y-%m-%dT%H:%M:%SZ").replace(
+            tzinfo=datetime.timezone.utc)
+    except (TypeError, ValueError):
+        return None
+
+
+def seed_from_bench_rounds(store: HistoryStore,
+                           root: str | pathlib.Path) -> dict:
+    """Imports BENCH_r0*.json (round order) + BENCH_CACHE.json into the
+    store. Returns {"rounds": n_files, "recorded": n_entries,
+    "skipped": unparseable_files}.
+
+    Timestamp discipline: the detector picks each series' candidate by
+    ``recorded_at``, and the cache holds the LAST-GOOD (newest) numbers
+    while the round records predate it but carry no timestamps of their
+    own (file mtimes are checkout time — useless). So round i of N is
+    stamped ``anchor - (N - i) minutes`` where ``anchor`` is the oldest
+    ``measured_at`` in the cache (or now, without a cache): the rounds'
+    relative order is preserved, every seeded entry sits in the past
+    relative to the cache and to any future live append, and a backfill
+    seed can never masquerade as the newest measurement.
+    """
+    root = pathlib.Path(root)
+    cache_path = root / "BENCH_CACHE.json"
+    cache: dict = {}
+    if cache_path.exists():
+        try:
+            cache = json.loads(cache_path.read_text())
+        except (OSError, json.JSONDecodeError):
+            cache = {}
+    stamps = [t for ent in cache.values() if isinstance(ent, dict)
+              for t in [_parse_iso_z(ent.get("measured_at"))] if t]
+    anchor = min(stamps, default=datetime.datetime.now(
+        datetime.timezone.utc))
+    round_paths = sorted(root.glob("BENCH_r[0-9]*.json"))
+    recorded, skipped = 0, []
+    for i, path in enumerate(round_paths):
+        report = _parse_round_report(path)
+        if report is None:
+            skipped.append(path.name)
+            continue
+        stamp = (anchor - datetime.timedelta(
+            minutes=len(round_paths) - i)).strftime("%Y-%m-%dT%H:%M:%SZ")
+        recorded += import_bench_report(store, report, source=path.name,
+                                        default_recorded_at=stamp)
+    if cache:
+        for section, ent in sorted(cache.items()):
+            if not (isinstance(ent, dict) and isinstance(
+                    ent.get("payload"), dict)):
+                continue
+            # Cache keys already use history section names ("sweep",
+            # "chain", ...); unknown ones (e.g. "sharded_chain", a
+            # determinism record, not a perf metric) fall out of
+            # record() as a no-op.
+            if store.record(section, ent["payload"],
+                            source="BENCH_CACHE.json",
+                            recorded_at=ent.get("measured_at"),
+                            dedupe=True):
+                recorded += 1
+    return {"rounds": len(round_paths), "recorded": recorded,
+            "skipped": skipped}

@@ -1,0 +1,245 @@
+"""Live observability endpoint: /metrics, /healthz, /events over HTTP.
+
+A ``ThreadingHTTPServer`` on a daemon thread, standard library only, so
+any long mine/sim/bench run can be scraped WHILE in flight — the
+dump-on-exit exporters (``--metrics-dump``, the flight recorder) only
+ever show a run that already ended.
+
+Endpoints (catalogue: docs/perfwatch.md):
+
+* ``/metrics``  — the default registry's Prometheus text snapshot,
+  rendered on demand per scrape (never cached: the point is liveness).
+* ``/healthz``  — JSON liveness + last-progress-age watchdog. Progress
+  is read off the ``*_heartbeat`` gauges (miner/sim/bench each stamp one
+  per unit of work; see docs/observability.md): the endpoint is healthy
+  while the freshest heartbeat is younger than the stall budget
+  (``MPIBT_HEALTHZ_STALL`` seconds, default 30), degrades to
+  ``starting`` while no heartbeat has ever been stamped and the budget
+  has not elapsed since server start, and flips to 503 when progress
+  stalls — a wedged device init (heartbeat stamped at phase entry, then
+  silence) and a stalled sim both trip it.
+* ``/events``   — the newest ``?n=`` (default 64) records of the bounded
+  JSON event ring, **redacted**: values under path/argv/env-like keys
+  are masked and long strings truncated, so an operator-facing scrape
+  of a shared box never leaks filesystem layout or command lines.
+
+Shutdown: ``close()`` stops the accept loop and closes the socket;
+request handler threads are daemonic so an in-flight scrape cannot hold
+the process open. The CLI wires ``close()`` into the same ``finally``
+that writes ``--metrics-dump``, so every exit path — including an
+uncaught exception on its way to the flight-recorder excepthook —
+releases the port before the process dies.
+"""
+from __future__ import annotations
+
+import http.server
+import json
+import socket
+import threading
+import time
+import urllib.parse
+
+from ..telemetry import default_registry
+from ..telemetry.events import env_number, recent_events
+
+# Default last-progress stall budget (seconds) before /healthz flips
+# unhealthy. Generous: a legitimate big-batch TPU dispatch can hold the
+# host for a few seconds; a wedged init holds it for minutes.
+DEFAULT_STALL_S = env_number("MPIBT_HEALTHZ_STALL", 30.0, cast=float,
+                             minimum=1e-3)
+
+HEARTBEAT_SUFFIX = "_heartbeat"
+
+# /events redaction: mask values whose key smells like host detail
+# (paths, command lines, environment), truncate anything huge.
+_REDACT_KEY_PARTS = ("path", "argv", "env", "cmd", "dir", "file", "cwd")
+_MAX_VALUE_CHARS = 200
+
+
+def redact_event(record: dict) -> dict:
+    """One event record, safe for an operator-facing endpoint."""
+    out: dict = {}
+    for k, v in record.items():
+        key = str(k).lower()
+        if any(part in key for part in _REDACT_KEY_PARTS):
+            out[k] = "[redacted]"
+            continue
+        if isinstance(v, str) and len(v) > _MAX_VALUE_CHARS:
+            v = v[:_MAX_VALUE_CHARS] + "...[truncated]"
+        out[k] = v
+    return out
+
+
+# Servers started in this process, newest last — the CLI announces the
+# bound port from here and tests poll it to find an in-flight server.
+_active: list["MetricsServer"] = []
+_active_lock = threading.Lock()
+
+
+def active_server() -> "MetricsServer | None":
+    """The most recently started, still-open server in this process."""
+    with _active_lock:
+        return _active[-1] if _active else None
+
+
+class MetricsServer:
+    """The threaded endpoint. ``port=0`` binds an ephemeral port;
+    ``start()`` returns the actual one."""
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1",
+                 stall_s: float | None = None, registry=None):
+        self.host = host
+        self.port = int(port)
+        self.stall_s = float(stall_s if stall_s is not None
+                             else DEFAULT_STALL_S)
+        # Resolved per request when None — the registry can be reset()
+        # under a live server and scrapes must follow the swap.
+        self._registry = registry
+        self._httpd: http.server.ThreadingHTTPServer | None = None
+        self._thread: threading.Thread | None = None
+        self._started_at: float | None = None
+
+    # ---- lifecycle -------------------------------------------------------
+
+    def start(self) -> int:
+        """Bind + serve on a daemon thread; returns the bound port."""
+        outer = self
+
+        class Handler(_Handler):
+            server_ctx = outer
+
+        self._httpd = http.server.ThreadingHTTPServer(
+            (self.host, self.port), Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._started_at = time.monotonic()
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, kwargs={"poll_interval": 0.1},
+            name=f"perfwatch-metrics-{self.port}", daemon=True)
+        self._thread.start()
+        with _active_lock:
+            _active.append(self)
+        return self.port
+
+    def close(self) -> None:
+        """Stop accepting, close the socket, leave no thread behind.
+        Idempotent — every CLI exit path calls this."""
+        httpd, thread = self._httpd, self._thread
+        self._httpd = self._thread = None
+        with _active_lock:
+            if self in _active:
+                _active.remove(self)
+        if httpd is not None:
+            httpd.shutdown()
+            httpd.server_close()
+        if thread is not None:
+            thread.join(timeout=5)
+
+    def url(self, path: str = "/metrics") -> str:
+        return f"http://{self.host}:{self.port}{path}"
+
+    # ---- endpoint payloads ----------------------------------------------
+
+    def registry(self):
+        return (self._registry if self._registry is not None
+                else default_registry())
+
+    def health(self) -> tuple[int, dict]:
+        """(http status, payload) for /healthz.
+
+        Healthy while the freshest ``*_heartbeat`` gauge is younger than
+        the stall budget; ``starting`` (still 200) while none has ever
+        been stamped and the budget has not elapsed since server start;
+        503 otherwise — with per-heartbeat detail so the stalled layer
+        is named, not guessed.
+        """
+        beats: dict[str, dict] = {}
+        freshest: float | None = None
+        for m in self.registry().metrics():
+            if m.kind != "gauge" or not m.name.endswith(HEARTBEAT_SUFFIX):
+                continue
+            age = m.age_s()
+            label = m.name + "".join(f"{{{k}={v}}}" for k, v in m.labels)
+            beats[label] = {"value": m.value,
+                            "age_s": None if age is None else round(age, 3)}
+            if age is not None and (freshest is None or age < freshest):
+                freshest = age
+        uptime = (time.monotonic() - self._started_at
+                  if self._started_at is not None else 0.0)
+        if freshest is not None and freshest <= self.stall_s:
+            status, code = "ok", 200
+        elif freshest is None and uptime <= self.stall_s:
+            status, code = "starting", 200
+        elif freshest is None:
+            status, code = "no-progress", 503
+        else:
+            status, code = "stalled", 503
+        return code, {
+            "status": status,
+            "healthy": code == 200,
+            "stall_threshold_s": self.stall_s,
+            "uptime_s": round(uptime, 3),
+            "last_progress_age_s": (None if freshest is None
+                                    else round(freshest, 3)),
+            "heartbeats": beats,
+        }
+
+    def events_tail(self, n: int) -> list[dict]:
+        return [redact_event(r) for r in recent_events(n)]
+
+
+class _Handler(http.server.BaseHTTPRequestHandler):
+    server_ctx: MetricsServer  # bound by MetricsServer.start
+
+    # Scrapes must not spam the run's stderr.
+    def log_message(self, format, *args):  # noqa: A002 (stdlib signature)
+        pass
+
+    def _send(self, code: int, body: str, content_type: str) -> None:
+        data = body.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        try:
+            self.wfile.write(data)
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # scraper went away mid-reply; nothing to salvage
+
+    def do_GET(self) -> None:  # noqa: N802 (stdlib signature)
+        parsed = urllib.parse.urlparse(self.path)
+        ctx = self.server_ctx
+        if parsed.path == "/metrics":
+            self._send(200, ctx.registry().render_prometheus(),
+                       "text/plain; version=0.0.4; charset=utf-8")
+        elif parsed.path == "/healthz":
+            code, payload = ctx.health()
+            self._send(code, json.dumps(payload, sort_keys=True) + "\n",
+                       "application/json")
+        elif parsed.path == "/events":
+            q = urllib.parse.parse_qs(parsed.query)
+            try:
+                n = max(1, int(q.get("n", ["64"])[0]))
+            except ValueError:
+                n = 64
+            body = "\n".join(json.dumps(r, sort_keys=True, default=str)
+                             for r in ctx.events_tail(n))
+            self._send(200, body + ("\n" if body else ""),
+                       "application/json")
+        else:
+            self._send(404, json.dumps({
+                "error": f"unknown path {parsed.path!r}",
+                "endpoints": ["/metrics", "/healthz", "/events"]}) + "\n",
+                "application/json")
+
+
+def wait_listening(host: str, port: int, timeout_s: float = 5.0) -> bool:
+    """Polls until a TCP connect succeeds (test/smoke helper)."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            with socket.create_connection((host, port), timeout=0.2):
+                return True
+        except OSError:
+            time.sleep(0.02)
+    return False

@@ -21,7 +21,8 @@ from typing import Callable
 from . import core
 from .backend import MinerBackend, backend_from_config
 from .config import ConfigError, MinerConfig, extend_payload
-from .telemetry import CausalLog, counter, dump_causal_logs, gauge, histogram
+from .telemetry import (CausalLog, counter, dump_causal_logs, gauge,
+                        heartbeat, histogram)
 
 # RecvResult codes as stable event vocabulary for the causal logs.
 _RESULT_NAMES = {
@@ -368,6 +369,9 @@ class Network:
                 self.broadcast(node.id, mined)
         self.step_count += 1
         self.mirror_stats()
+        # Progress heartbeat: /healthz watches the last_set age, so a
+        # stalled sim (wedged backend, runaway step) flips unhealthy.
+        heartbeat("sim_heartbeat").set(self.step_count)
 
     def mirror_stats(self) -> None:
         """Mirrors every group's GroupStats (+ height) as labeled gauges

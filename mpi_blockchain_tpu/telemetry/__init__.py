@@ -52,6 +52,21 @@ def gauge(name: str, help: str = "", **labels) -> Gauge:
     return default_registry().gauge(name, help=help, **labels)
 
 
+def heartbeat(name: str) -> Gauge:
+    """Get-or-create a progress-heartbeat gauge: the VALUE is a progress
+    marker (height, step, tick count); the gauge's ``last_set`` AGE is
+    what perfwatch's ``/healthz`` watchdog watches. The one registration
+    point, so every layer's heartbeat carries the same help text and the
+    ``*_heartbeat`` naming contract the watchdog matches on holds."""
+    if not name.endswith("_heartbeat"):
+        raise MetricError(f"heartbeat gauge {name!r} must end "
+                          f"'_heartbeat' (the /healthz watchdog matches "
+                          f"on the suffix)")
+    return default_registry().gauge(
+        name, help="progress heartbeat (value: progress marker; "
+                   "last_set age: staleness)")
+
+
 def histogram(name: str, help: str = "", **labels) -> Histogram:
     """Get-or-create a histogram on the default registry."""
     return default_registry().histogram(name, help=help, **labels)

@@ -27,6 +27,7 @@ from __future__ import annotations
 import collections
 import random
 import threading
+import time
 import zlib
 from typing import Iterable
 
@@ -108,38 +109,64 @@ class Counter(_Metric):
 
 
 class Gauge(_Metric):
-    """Point-in-time value: set / inc / dec."""
+    """Point-in-time value: set / inc / dec.
+
+    Every mutation stamps ``last_set`` on the monotonic clock, so readers
+    can tell "0 because idle since t" from "0 because never set" —
+    ``age_s()`` is None until the first mutation, and a never-set gauge
+    emits NO Prometheus sample (its 0.0 default would be a lie). The
+    perfwatch ``/healthz`` watchdog is built on this: heartbeat gauges
+    (``*_heartbeat``) whose age exceeds the stall budget flip the
+    endpoint unhealthy.
+    """
 
     kind = "gauge"
 
     def __init__(self, name, help="", labels=()):
         super().__init__(name, help, labels)
         self._value = 0.0
+        self._last_set: float | None = None
 
     def set(self, value: float) -> None:
         with self._lock:
             self._value = value
+            self._last_set = time.monotonic()
 
     def inc(self, amount: float = 1) -> None:
         with self._lock:
             self._value += amount
+            self._last_set = time.monotonic()
 
     def dec(self, amount: float = 1) -> None:
         with self._lock:
             self._value -= amount
+            self._last_set = time.monotonic()
 
     @property
     def value(self):
         with self._lock:
             return self._value
 
+    def age_s(self) -> float | None:
+        """Seconds since the last mutation; None when never set."""
+        with self._lock:
+            if self._last_set is None:
+                return None
+            return time.monotonic() - self._last_set
+
     def sample_lines(self) -> list[str]:
+        with self._lock:
+            never_set = self._last_set is None
+        if never_set:
+            return []
         return [f"{self.name}{_render_labels(self.labels)} "
                 f"{_render_value(self.value)}"]
 
     def to_dict(self) -> dict:
+        age = self.age_s()
         return {"kind": self.kind, "labels": dict(self.labels),
-                "value": self.value}
+                "value": self.value,
+                "age_s": None if age is None else round(age, 3)}
 
 
 class Histogram(_Metric):

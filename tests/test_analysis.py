@@ -280,6 +280,71 @@ def test_tel001_live_simulation_clean():
     assert run_telemetry_lint(ROOT) == []
 
 
+# ---- TEL002: metric naming/unit-suffix convention ----------------------
+
+
+BAD_METRICS = textwrap.dedent("""\
+    from mpi_blockchain_tpu.telemetry import counter, gauge, histogram
+
+
+    def instrument():
+        counter("requests").inc()              # counter without _total
+        gauge("queue_total").set(1)            # gauge masquerading
+        histogram("latency").observe(1.0)      # no unit suffix
+        histogram("x_count").observe(1.0)      # reserved summary suffix
+        counter("good_total").inc()            # compliant
+        gauge("ok_heartbeat").set(1)           # compliant
+        histogram("lat_ms").observe(1.0)       # compliant
+        gauge(f"dyn_{1}").set(1)               # non-literal: skipped
+    """)
+
+
+def test_tel002_naming_violations_fire(tmp_path):
+    from mpi_blockchain_tpu.analysis.telemetry_lint import run_telemetry_lint
+
+    bad = tmp_path / "bad_metrics.py"
+    bad.write_text(BAD_METRICS)
+    findings = run_telemetry_lint(ROOT, overrides={"telemetry_files": [bad]})
+    assert rule_set(findings) == {"TEL002"}
+    assert len(findings) == 4
+    msgs = " | ".join(f.message for f in findings)
+    assert "'requests'" in msgs and "_total" in msgs
+    assert "'queue_total'" in msgs
+    assert "'latency'" in msgs and "unit suffix" in msgs
+    assert "'x_count'" in msgs
+
+
+def test_tel002_inline_suppression(tmp_path):
+    suppressed = BAD_METRICS.replace(
+        'counter("requests").inc()              # counter without _total',
+        'counter("requests").inc()  # chainlint: disable=TEL002')
+    bad = tmp_path / "bad_metrics.py"
+    bad.write_text(suppressed)
+    findings = run_all(root=tmp_path, passes=["telemetry"],
+                       overrides={"telemetry_files": [bad],
+                                  "sim_py": SIM_PY})
+    assert len([f for f in findings if f.rule == "TEL002"]) == 3
+
+
+def test_tel002_live_tree_clean():
+    """The whole package obeys its own naming convention."""
+    from mpi_blockchain_tpu.analysis.telemetry_lint import run_telemetry_lint
+
+    findings = [f for f in run_telemetry_lint(ROOT) if f.rule == "TEL002"]
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_tel002_cli_pass_family(tmp_path):
+    bad = tmp_path / "bad_metrics.py"
+    bad.write_text(BAD_METRICS)
+    proc = subprocess.run(
+        [sys.executable, "-m", "mpi_blockchain_tpu.analysis",
+         "--passes", "telemetry", "--override", f"telemetry_files={bad}"],
+        cwd=ROOT, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "TEL002" in proc.stdout
+
+
 def test_tel001_cli_pass_family(tmp_path):
     drifted = _drifted_sim(tmp_path, """
 

@@ -17,6 +17,8 @@ import bench
 @pytest.fixture
 def tmp_cache(tmp_path, monkeypatch):
     monkeypatch.setattr(bench, "CACHE_PATH", tmp_path / "CACHE.json")
+    monkeypatch.setattr(bench, "HISTORY_PATH",
+                        tmp_path / "PERF_HISTORY.jsonl")
 
 
 # ---- cache ------------------------------------------------------------------
@@ -127,6 +129,26 @@ def test_main_fresh_device_record(tmp_cache, monkeypatch, capsys):
     for section in ("sweep", "chain", "tpu_single", "sharded_pallas",
                     "utilization"):
         assert bench._cached(section) is not None
+    # ... and the fresh ones were auto-recorded into the perfwatch
+    # history (the sentinel's trajectory accumulates with no manual step)
+    from mpi_blockchain_tpu.perfwatch.history import HistoryStore
+    recorded = {e.section for e in HistoryStore(bench.HISTORY_PATH).entries()}
+    assert {"cpu_np8", "sweep", "chain"} <= recorded
+
+
+def test_main_no_record_opts_out(tmp_cache, monkeypatch, capsys):
+    dev = {"platform": "tpu", "sweep": dict(_SWEEP)}
+    from mpi_blockchain_tpu import bench_lib
+    monkeypatch.setattr(bench_lib, "bench_cpu",
+                        lambda seconds, n_miners: dict(_CPU))
+    monkeypatch.setattr(bench, "_run_device_section", lambda: (dev, None))
+    monkeypatch.setattr(bench, "_run_sharded_section",
+                        lambda: (_SHARDED, None))
+    monkeypatch.setattr(bench, "_run_roofline_section",
+                        lambda mhs: ({"utilization": {}}, None))
+    assert bench.main(["--no-record"]) == 0
+    capsys.readouterr()
+    assert not bench.HISTORY_PATH.exists()
 
 
 def test_main_falls_back_to_cache_on_device_outage(tmp_cache, monkeypatch,

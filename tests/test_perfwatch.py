@@ -1,0 +1,588 @@
+"""perfwatch subsystem tests (mpi_blockchain_tpu/perfwatch).
+
+Covers the live HTTP endpoint (ephemeral bind, /metrics on-demand
+render, /healthz heartbeat watchdog incl. the stall flip, /events
+redaction, concurrent scrape during a live simulation, clean shutdown),
+the history store (record/read, key identity, BENCH_r0* seeding), the
+spread-aware regression detector (injected 20% drop fires, within-spread
+noise passes), the roofline/span attribution, and the CLI acceptance
+criteria (`check` exit codes; `sim --serve-metrics 0` scraped live).
+"""
+import json
+import pathlib
+import re
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from mpi_blockchain_tpu import telemetry
+from mpi_blockchain_tpu.perfwatch.attribution import (attribute_spans,
+                                                      utilization)
+from mpi_blockchain_tpu.perfwatch.detector import (check_candidate,
+                                                   check_history,
+                                                   regressions)
+from mpi_blockchain_tpu.perfwatch.history import (HistoryStore, entry_key,
+                                                  seed_from_bench_rounds)
+from mpi_blockchain_tpu.perfwatch.server import (MetricsServer,
+                                                 active_server,
+                                                 redact_event)
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+SWEEP_ID = {"kernel": "pallas", "batch_pow2": 28, "n_miners": 1}
+
+
+@pytest.fixture(autouse=True)
+def fresh_registry():
+    telemetry.reset()
+    telemetry.clear_events()
+    yield
+    telemetry.reset()
+    telemetry.clear_events()
+
+
+def _get(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, r.read().decode()
+
+
+@pytest.fixture
+def server():
+    srv = MetricsServer(port=0, stall_s=60.0)
+    srv.start()
+    yield srv
+    srv.close()
+
+
+# ---- server: bind + endpoints ------------------------------------------
+
+
+def test_port_zero_binds_ephemeral_and_registers():
+    a, b = MetricsServer(port=0), MetricsServer(port=0)
+    try:
+        pa, pb = a.start(), b.start()
+        assert pa != 0 and pb != 0 and pa != pb
+        assert active_server() is b          # newest last
+    finally:
+        b.close()
+        assert active_server() is a
+        a.close()
+        assert active_server() is None
+
+
+def test_metrics_endpoint_renders_on_demand(server):
+    telemetry.counter("pw_probe_total", help="probe").inc(3)
+    status, body = _get(server.url("/metrics"))
+    assert status == 200
+    assert "# TYPE pw_probe_total counter" in body
+    assert "pw_probe_total 3" in body
+    # On-demand, not cached: a later mutation shows on the next scrape.
+    telemetry.counter("pw_probe_total").inc()
+    assert "pw_probe_total 4" in _get(server.url("/metrics"))[1]
+
+
+def test_unknown_path_404(server):
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get(server.url("/nope"))
+    assert ei.value.code == 404
+    assert "/healthz" in ei.value.read().decode()
+
+
+def test_clean_shutdown_frees_port():
+    srv = MetricsServer(port=0)
+    port = srv.start()
+    assert _get(srv.url("/metrics"))[0] == 200
+    srv.close()
+    srv.close()                              # idempotent
+    with pytest.raises(urllib.error.URLError):
+        _get(f"http://127.0.0.1:{port}/metrics", timeout=1)
+
+
+# ---- server: /healthz watchdog -----------------------------------------
+
+
+def test_healthz_starting_then_ok_then_stalled():
+    srv = MetricsServer(port=0, stall_s=0.3)
+    try:
+        srv.start()
+        status, body = _get(srv.url("/healthz"))
+        assert status == 200
+        assert json.loads(body)["status"] == "starting"
+        telemetry.gauge("sim_heartbeat").set(7)
+        status, body = _get(srv.url("/healthz"))
+        h = json.loads(body)
+        assert status == 200 and h["status"] == "ok"
+        assert h["heartbeats"]["sim_heartbeat"]["value"] == 7
+        time.sleep(0.4)                      # heartbeat goes stale
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(srv.url("/healthz"))
+        assert ei.value.code == 503
+        h = json.loads(ei.value.read().decode())
+        assert h["status"] == "stalled"
+        assert h["last_progress_age_s"] > 0.3
+        # Progress resumes: healthy again (no latch).
+        telemetry.gauge("sim_heartbeat").set(8)
+        assert json.loads(_get(srv.url("/healthz"))[1])["status"] == "ok"
+    finally:
+        srv.close()
+
+
+def test_healthz_no_progress_after_startup_budget():
+    """The wedged-device-init shape: no heartbeat is EVER stamped; once
+    the stall budget elapses from server start, /healthz flips."""
+    srv = MetricsServer(port=0, stall_s=0.2)
+    try:
+        srv.start()
+        time.sleep(0.3)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(srv.url("/healthz"))
+        assert ei.value.code == 503
+        assert json.loads(ei.value.read().decode())["status"] == "no-progress"
+    finally:
+        srv.close()
+
+
+def test_never_set_gauge_invisible_to_healthz_and_prometheus(server):
+    """Gauge staleness: a merely-registered heartbeat must read as 'never
+    set', not as a fresh 0."""
+    g = telemetry.gauge("idle_heartbeat")
+    assert g.age_s() is None
+    h = json.loads(_get(server.url("/healthz"))[1])
+    assert h["heartbeats"]["idle_heartbeat"]["age_s"] is None
+    assert h["status"] == "starting"         # no PROGRESS stamped yet
+    assert "idle_heartbeat 0" not in _get(server.url("/metrics"))[1]
+
+
+# ---- server: /events redaction -----------------------------------------
+
+
+def test_events_tail_redacts_and_bounds(server):
+    for i in range(5):
+        telemetry.emit_event({"event": "pw_test", "n": i,
+                              "dump_path": f"/secret/location/{i}",
+                              "blob": "x" * 500})
+    status, body = _get(server.url("/events?n=3"))
+    assert status == 200
+    records = [json.loads(line) for line in body.splitlines()]
+    assert [r["n"] for r in records] == [2, 3, 4]   # newest-3 tail
+    for r in records:
+        assert r["dump_path"] == "[redacted]"
+        assert r["blob"].endswith("...[truncated]")
+        assert len(r["blob"]) < 300
+
+
+def test_redact_event_unit():
+    r = redact_event({"event": "e", "argv": ["a"], "cwd": "/x",
+                      "height": 3})
+    assert r == {"event": "e", "argv": "[redacted]",
+                 "cwd": "[redacted]", "height": 3}
+
+
+# ---- server: concurrent scrape during a live sim ------------------------
+
+
+def test_concurrent_scrape_during_live_sim(server):
+    """ISSUE acceptance: /metrics serves valid snapshots WHILE an
+    adversarial simulation runs, and /healthz reports healthy off the
+    sim heartbeat."""
+    from mpi_blockchain_tpu.simulation import run_adversarial
+
+    done = threading.Event()
+    err: list = []
+
+    def sim():
+        try:
+            run_adversarial(partition_steps=30, target_height=10,
+                            nonce_budget=1 << 7, drop_rate_pct=10, seed=1)
+        except Exception as e:  # surfaced below, not swallowed
+            err.append(e)
+        finally:
+            done.set()
+
+    t = threading.Thread(target=sim, daemon=True)
+    t.start()
+    saw_live_metrics = saw_healthy = False
+    while not done.is_set():
+        _, body = _get(server.url("/metrics"))
+        if "sim_heartbeat" in body and "sim_messages_sent_total" in body:
+            saw_live_metrics = True
+            h = json.loads(_get(server.url("/healthz"))[1])
+            if h["status"] == "ok":
+                saw_healthy = True
+        time.sleep(0.005)
+    t.join(timeout=60)
+    assert not err, err
+    assert saw_live_metrics, "never scraped sim metrics mid-run"
+    assert saw_healthy, "healthz never reported ok off the sim heartbeat"
+    # Post-run the snapshot is still consistent (render under no load).
+    assert "sim_group_height" in _get(server.url("/metrics"))[1]
+
+
+# ---- history store ------------------------------------------------------
+
+
+def test_history_record_and_key_identity(tmp_path):
+    store = HistoryStore(tmp_path / "h.jsonl")
+    e = store.record("sweep", {**SWEEP_ID, "hashes_per_sec_per_chip": 9e8,
+                               "spread_pct": 0.5}, source="t")
+    assert e.key == "sweep/pallas/b28/m1"
+    assert entry_key("sweep", {**SWEEP_ID, "kernel": "jnp"}) != e.key
+    # unknown section / missing metric -> not recorded
+    assert store.record("nope", {"x": 1}) is None
+    assert store.record("sweep", {"kernel": "pallas"}) is None
+    assert len(store.entries()) == 1
+    # corrupt lines are skipped, not fatal
+    with store.path.open("a") as f:
+        f.write("{not json\n")
+    assert len(store.entries()) == 1
+
+
+def test_history_seed_from_bench_rounds(tmp_path):
+    """Seeding imports the repo's real BENCH_r0*.json + BENCH_CACHE.json:
+    fresh entries only, deduped, unparseable rounds reported."""
+    store = HistoryStore(tmp_path / "h.jsonl")
+    result = seed_from_bench_rounds(store, ROOT)
+    assert result["rounds"] >= 5
+    assert result["recorded"] >= 8
+    sweeps = store.entries("sweep")
+    assert sweeps, "no sweep trajectory seeded"
+    assert all(e.value > 1e8 for e in sweeps)
+    # cached payloads are never double-imported
+    assert all("cached" not in e.payload or not e.payload["cached"]
+               for e in store.entries())
+
+
+# ---- regression detector ------------------------------------------------
+
+
+def _seed(store, *values, spread=0.5, section="sweep",
+          metric="hashes_per_sec_per_chip"):
+    for v in values:
+        store.record(section, {**SWEEP_ID, metric: v,
+                               "spread_pct": spread}, source="t")
+
+
+def test_detector_flags_injected_20pct_drop(tmp_path):
+    store = HistoryStore(tmp_path / "h.jsonl")
+    _seed(store, 970e6, 969e6, 776e6)        # -20% vs best
+    bad = regressions(check_history(store))
+    assert len(bad) == 1
+    f = bad[0]
+    assert f.verdict == "regression" and f.section == "sweep"
+    assert f.delta_pct == pytest.approx(20.0, abs=0.1)
+    assert f.allowed_pct == 10.0             # max(10, 2*0.5)
+
+
+def test_detector_passes_within_spread_noise(tmp_path):
+    store = HistoryStore(tmp_path / "h.jsonl")
+    _seed(store, 970e6, 965e6, spread=0.5)   # -0.5%: noise
+    findings = check_history(store)
+    assert regressions(findings) == []
+    assert findings[0].verdict == "ok"
+
+
+def test_detector_spread_widens_allowance(tmp_path):
+    """A noisy series (big recorded rep spread) must not page on a drop
+    the spread already explains: allowed = max(threshold, k*spread)."""
+    store = HistoryStore(tmp_path / "h.jsonl")
+    _seed(store, 970e6, 820e6, spread=9.0)   # -15.5%, allowed 18%
+    findings = check_history(store)
+    assert findings[0].verdict == "ok"
+    assert findings[0].allowed_pct == 18.0
+    # The same drop on a tight series IS a regression.
+    tight = HistoryStore(tmp_path / "t.jsonl")
+    _seed(tight, 970e6, 820e6, spread=0.5)
+    assert regressions(check_history(tight))
+
+
+def test_detector_lower_is_better_direction(tmp_path):
+    store = HistoryStore(tmp_path / "h.jsonl")
+    _seed(store, 18.6, 23.0, section="chain", metric="wall_s")
+    bad = regressions(check_history(store))
+    assert len(bad) == 1
+    assert bad[0].delta_pct == pytest.approx(23.7, abs=0.1)
+    improved = HistoryStore(tmp_path / "i.jsonl")
+    _seed(improved, 23.0, 18.6, section="chain", metric="wall_s")
+    assert check_history(improved)[0].verdict == "improved"
+
+
+def test_detector_candidate_not_recorded(tmp_path):
+    store = HistoryStore(tmp_path / "h.jsonl")
+    _seed(store, 970e6)
+    f = check_candidate(store, "sweep",
+                        {**SWEEP_ID, "hashes_per_sec_per_chip": 700e6,
+                         "spread_pct": 0.5})
+    assert f.verdict == "regression"
+    assert len(store.entries()) == 1         # the gate did not record
+    with pytest.raises(ValueError, match="not regression-checked"):
+        check_candidate(store, "utilization", {"vpu_utilization_pct": 90})
+
+
+def test_detector_candidate_is_newest_by_recorded_at(tmp_path):
+    """A late BACKFILL (seed import appended after live entries, stamped
+    with its historical timestamp) must become baseline, not candidate:
+    recency is recorded_at, not file position."""
+    store = HistoryStore(tmp_path / "h.jsonl")
+    store.record("sweep", {**SWEEP_ID, "hashes_per_sec_per_chip": 970e6,
+                           "spread_pct": 0.5},
+                 recorded_at="2026-08-01T00:00:00Z", source="bench.py")
+    # an OLD, slower round imported afterwards (file order: last)
+    store.record("sweep", {**SWEEP_ID, "hashes_per_sec_per_chip": 600e6,
+                           "spread_pct": 0.5},
+                 recorded_at="2026-07-01T00:00:00Z", source="BENCH_r02.json")
+    findings = check_history(store)
+    assert findings[0].verdict == "improved"     # 970e6 judged vs 600e6
+    assert findings[0].candidate == 970e6
+    # the mirror image: a genuinely regressed latest run cannot hide
+    # behind a stale-but-better line appended after it
+    store2 = HistoryStore(tmp_path / "h2.jsonl")
+    store2.record("sweep", {**SWEEP_ID, "hashes_per_sec_per_chip": 700e6,
+                            "spread_pct": 0.5},
+                  recorded_at="2026-08-01T00:00:00Z", source="bench.py")
+    store2.record("sweep", {**SWEEP_ID, "hashes_per_sec_per_chip": 970e6,
+                            "spread_pct": 0.5},
+                  recorded_at="2026-07-01T00:00:00Z",
+                  source="BENCH_r02.json")
+    assert regressions(check_history(store2))
+
+
+def test_seed_stamps_rounds_before_the_cache(tmp_path):
+    """Round records carry no timestamps; the seeder stamps round i of N
+    at anchor - (N-i) minutes, anchor = the cache's oldest measured_at —
+    so rounds keep their order, sit BEFORE the cache (the last-good,
+    newest numbers), and a backfill can never pose as the newest entry."""
+    for n, v in (("01", 1.0e6), ("02", 1.2e6)):
+        (tmp_path / f"BENCH_r{n}.json").write_text(json.dumps({"parsed": {
+            "detail": {"cpu_np8": {"hashes_per_sec": v}}}}))
+    (tmp_path / "BENCH_CACHE.json").write_text(json.dumps({
+        "sweep": {"measured_at": "2026-07-30T07:53:17Z",
+                  "payload": {"hashes_per_sec_per_chip": 9.7e8}}}))
+    store = HistoryStore(tmp_path / "h.jsonl")
+    seed_from_bench_rounds(store, tmp_path)
+    r1, r2 = store.entries("cpu_np8")
+    assert r1.recorded_at == "2026-07-30T07:51:17Z"   # anchor - 2 min
+    assert r2.recorded_at == "2026-07-30T07:52:17Z"   # anchor - 1 min
+    (cache_entry,) = store.entries("sweep")
+    assert cache_entry.recorded_at == "2026-07-30T07:53:17Z"
+    assert r2.recorded_at < cache_entry.recorded_at
+
+
+def test_detector_single_entry_insufficient(tmp_path):
+    store = HistoryStore(tmp_path / "h.jsonl")
+    _seed(store, 970e6)
+    findings = check_history(store)
+    assert findings[0].verdict == "insufficient-history"
+    assert regressions(findings) == []
+
+
+# ---- attribution --------------------------------------------------------
+
+
+def test_utilization_matches_recorded_roofline():
+    """The formalized closed form must reproduce the repo's recorded
+    utilization record (BENCH_CACHE: 969.85 MH/s, 6055 ALU ops -> 95.4%)."""
+    u = utilization(969846271.28, 6055)
+    assert u["vpu_utilization_pct"] == 95.4
+    assert u["vpu_peak_u32_tops"] == 6.16
+    assert u["v5e_clock_ghz"] == 1.503
+
+
+def test_attribute_spans_buckets_and_dominant():
+    reg = telemetry.default_registry()
+    from mpi_blockchain_tpu.telemetry.spans import Span
+    for name, dur in (("backend.tpu.dispatch", 5.0),
+                      ("miner.append", 1.0),
+                      ("bench.device_init", 0.5),
+                      ("miner.block", 0.25)):
+        reg.record_span(Span(name=name, duration_s=dur))
+    att = attribute_spans(reg)
+    assert att["dominant"] == "device"
+    assert att["buckets"]["device"]["seconds"] == 5.0
+    assert att["buckets"]["host"]["seconds"] == 1.0
+    assert att["buckets"]["init"]["seconds"] == 0.5
+    assert att["buckets"]["other"]["spans"] == {"miner.block": 0.25}
+    assert sum(b["fraction"] for b in att["buckets"].values()) \
+        == pytest.approx(1.0, abs=0.01)
+
+
+def test_attribute_spans_empty_registry():
+    from mpi_blockchain_tpu.telemetry import Registry
+    assert attribute_spans(Registry())["dominant"] is None
+
+
+# ---- CLI acceptance -----------------------------------------------------
+
+
+def _cli(args, **kw):
+    return subprocess.run(
+        [sys.executable, "-m", "mpi_blockchain_tpu.perfwatch", *args],
+        cwd=ROOT, capture_output=True, text=True, timeout=300, **kw)
+
+
+def test_cli_check_exits_nonzero_on_injected_drop(tmp_path):
+    """The literal acceptance command: a synthetic history with a 20%
+    drop -> exit 1; within-spread noise -> exit 0."""
+    hist = tmp_path / "h.jsonl"
+    store = HistoryStore(hist)
+    _seed(store, 970e6, 776e6)
+    proc = _cli(["check", "--history", str(hist)])
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "REGRESSION" in proc.stdout
+
+    clean = tmp_path / "c.jsonl"
+    _seed(HistoryStore(clean), 970e6, 967e6)
+    proc = _cli(["check", "--history", str(clean), "--json"])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert json.loads(proc.stdout)["regressions"] == 0
+
+
+def test_cli_record_seed_then_check_real_history(tmp_path):
+    hist = tmp_path / "h.jsonl"
+    proc = _cli(["record", "--history", str(hist), "--seed-bench-rounds"])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert json.loads(proc.stdout)["recorded"] >= 8
+    # The real trajectory must come out clean (no false paging).
+    proc = _cli(["check", "--history", str(hist)])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cli_record_single_payload_and_report(tmp_path):
+    hist = tmp_path / "h.jsonl"
+    payload = tmp_path / "sweep.json"
+    payload.write_text(json.dumps(
+        {**SWEEP_ID, "hashes_per_sec_per_chip": 9.7e8, "spread_pct": 0.2}))
+    proc = _cli(["record", "--history", str(hist), "--section", "sweep",
+                 "--payload", str(payload)])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert json.loads(proc.stdout)["key"] == "sweep/pallas/b28/m1"
+    proc = _cli(["report", "--history", str(hist)])
+    report = json.loads(proc.stdout)
+    assert report["series"]["sweep/pallas/b28/m1"]["count"] == 1
+    assert report["series"]["sweep/pallas/b28/m1"]["latest"] == 9.7e8
+
+
+def test_cli_check_candidate_gate(tmp_path):
+    hist = tmp_path / "h.jsonl"
+    _seed(HistoryStore(hist), 970e6)
+    cand = tmp_path / "cand.json"
+    cand.write_text(json.dumps(
+        {**SWEEP_ID, "hashes_per_sec_per_chip": 7e8, "spread_pct": 0.5}))
+    proc = _cli(["check", "--history", str(hist), "--section", "sweep",
+                 "--candidate", str(cand)])
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+
+
+def test_sim_serve_metrics_cli_live_scrape():
+    """ISSUE acceptance end-to-end: `sim --serve-metrics 0` announces an
+    ephemeral endpoint; /metrics + /healthz answer while the sim runs;
+    the port is released when the run exits."""
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "mpi_blockchain_tpu", "sim",
+         "--serve-metrics", "0", "--blocks", "8", "--partition-steps", "30"],
+        cwd=ROOT, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    try:
+        port = None
+        for line in proc.stderr:
+            m = re.search(r"serving metrics on http://127\.0\.0\.1:(\d+)",
+                          line)
+            if m:
+                port = int(m.group(1))
+                break
+        assert port, "no serve-metrics announcement on stderr"
+        base = f"http://127.0.0.1:{port}"
+        # Poll: the registry fills as soon as the sim takes its first
+        # steps; the endpoint itself is up from the announcement on.
+        deadline = time.monotonic() + 60
+        body = hz = ""
+        hz_status = None
+        while time.monotonic() < deadline and proc.poll() is None:
+            try:
+                probe = _get(f"{base}/metrics")[1]
+                if "sim_heartbeat" in probe:
+                    body = probe
+                    hz_status, hz = _get(f"{base}/healthz")
+                    break
+            except urllib.error.URLError:
+                break                         # run (and server) just ended
+            time.sleep(0.01)
+        assert "sim_heartbeat" in body and "# TYPE" in body
+        assert hz_status == 200
+        assert json.loads(hz)["status"] in ("ok", "starting")
+        out, _ = proc.communicate(timeout=120)
+        assert proc.returncode == 0
+        assert json.loads(out.splitlines()[-1])["converged"] is True
+        with pytest.raises(urllib.error.URLError):
+            _get(f"{base}/metrics", timeout=1)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate()
+
+
+def test_cli_env_var_enables_server_and_cleans_up(monkeypatch, capsys):
+    """MPIBT_METRICS_PORT arms the endpoint on a plain mine run, and the
+    finally-path shutdown leaves no active server behind."""
+    from mpi_blockchain_tpu.cli import main
+
+    monkeypatch.setenv("MPIBT_METRICS_PORT", "0")
+    rc = main(["mine", "--difficulty", "8", "--blocks", "1",
+               "--backend", "cpu"])
+    assert rc == 0
+    assert "serving metrics on http://127.0.0.1:" in capsys.readouterr().err
+    assert active_server() is None           # closed on the way out
+
+
+def test_cli_serve_metrics_bad_port_does_not_kill_run(monkeypatch, capsys):
+    """A taken port degrades to a warning; the run itself still succeeds."""
+    from mpi_blockchain_tpu.cli import main
+
+    blocker = MetricsServer(port=0)
+    port = blocker.start()
+    try:
+        rc = main(["mine", "--difficulty", "8", "--blocks", "1",
+                   "--backend", "cpu", "--serve-metrics", str(port)])
+        assert rc == 0
+        assert "serve-metrics failed" in capsys.readouterr().err
+    finally:
+        blocker.close()
+
+
+def test_cli_serve_metrics_out_of_range_port_degrades(capsys):
+    """An out-of-range port (bind raises OverflowError, not OSError) must
+    degrade exactly like a taken one, not kill the run."""
+    from mpi_blockchain_tpu.cli import main
+
+    rc = main(["mine", "--difficulty", "8", "--blocks", "1",
+               "--backend", "cpu", "--serve-metrics", "70000"])
+    assert rc == 0
+    assert "serve-metrics failed" in capsys.readouterr().err
+
+
+def test_cli_env_var_ignored_by_commands_without_a_run(monkeypatch,
+                                                       capsys, tmp_path):
+    """MPIBT_METRICS_PORT must not surprise-bind ports on verify/info —
+    the endpoint is a mine/sim/bench feature."""
+    from mpi_blockchain_tpu.cli import main
+
+    monkeypatch.setenv("MPIBT_METRICS_PORT", "0")
+    missing = tmp_path / "nope.bin"
+    main(["verify", "--chain", str(missing), "--difficulty", "8"])
+    assert "serving metrics on" not in capsys.readouterr().err
+    assert active_server() is None
+
+
+def test_cli_report_skips_roofline_without_census(tmp_path):
+    """A hand-recorded utilization payload carrying only the headline pct
+    must not crash the report — the roofline needs the op census."""
+    hist = tmp_path / "h.jsonl"
+    store = HistoryStore(hist)
+    _seed(store, 970e6)
+    store.record("utilization", {"vpu_utilization_pct": 95.0}, source="t")
+    proc = _cli(["report", "--history", str(hist)])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "roofline" not in json.loads(proc.stdout)

@@ -64,6 +64,56 @@ def test_gauge_set_inc_dec():
     assert g.value == 11.5
 
 
+def test_gauge_staleness_age():
+    """last_set distinguishes '0 because idle' from '0 because never
+    set': age_s is None until the first mutation, then tracks the
+    monotonic clock; every mutation kind refreshes it."""
+    import time
+
+    g = telemetry.gauge("stale_g")
+    assert g.age_s() is None
+    assert g.to_dict()["age_s"] is None
+    g.set(0)                                 # a REAL zero
+    first = g.age_s()
+    assert first is not None and first >= 0
+    time.sleep(0.02)
+    aged = g.age_s()
+    assert aged >= first + 0.01
+    g.inc()                                  # inc/dec refresh too
+    assert g.age_s() < aged
+    assert g.to_dict()["age_s"] is not None
+
+
+def test_never_set_gauge_emits_no_prometheus_sample():
+    """A merely-registered gauge must not render a lying 0; after the
+    first set its sample appears (value 0 included)."""
+    r = telemetry.Registry()
+    g = r.gauge("maybe_g", help="registered, not yet set")
+    out = r.render_prometheus()
+    assert "# TYPE maybe_g gauge" in out     # declared...
+    assert "\nmaybe_g " not in out           # ...but no sample line
+    g.set(0)
+    assert "maybe_g 0" in r.render_prometheus()
+
+
+def test_heartbeat_gauges_stamped_by_miner_and_sim():
+    """The /healthz progress sources: mining and simulation both stamp
+    their heartbeat gauges (satellite of the perfwatch ISSUE)."""
+    from mpi_blockchain_tpu.config import MinerConfig
+    from mpi_blockchain_tpu.models.miner import Miner
+    from mpi_blockchain_tpu.simulation import run_adversarial
+
+    Miner(MinerConfig(difficulty_bits=8, n_blocks=2,
+                      backend="cpu")).mine_chain()
+    hb = telemetry.gauge("miner_heartbeat")
+    assert hb.value == 2 and hb.age_s() is not None
+    net = run_adversarial(partition_steps=12, target_height=4,
+                          nonce_budget=1 << 8, drop_rate_pct=25, seed=0)
+    sim_hb = telemetry.gauge("sim_heartbeat")
+    assert sim_hb.value == net.step_count
+    assert sim_hb.age_s() is not None
+
+
 def test_histogram_quantiles_and_bounded_reservoir():
     r = Registry()
     h = r.histogram("lat_ms")
