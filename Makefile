@@ -4,9 +4,10 @@
 PY ?= python3
 SHELL := /bin/bash   # tier1 uses pipefail/PIPESTATUS
 
-.PHONY: check lint metrics-smoke forensics-smoke perf-smoke tier1 core clean
+.PHONY: check lint metrics-smoke forensics-smoke perf-smoke chaos-smoke \
+        tier1 core clean
 
-check: lint metrics-smoke forensics-smoke perf-smoke tier1
+check: lint metrics-smoke forensics-smoke perf-smoke chaos-smoke tier1
 
 # chainlint: binding contract, header layout, JAX purity, sanitizer matrix.
 lint:
@@ -51,6 +52,16 @@ forensics-smoke:
 	      len(t['traceEvents'])))" || \
 	    { echo "forensics-smoke: assertions failed"; rm -rf $$tmp; exit 1; }; \
 	rm -rf $$tmp
+
+# Chaos smoke: the resilience gate (docs/resilience.md) — a fixed fault
+# plan must produce byte-identical causal dumps across two sims, a
+# SIGKILL'd checkpointing mine must resume (incl. torn-tail truncation)
+# to a verifying chain, and a dead TPU dispatch must walk the
+# degradation ladder to cpu and still converge with rc 0.
+chaos-smoke:
+	env JAX_PLATFORMS=cpu $(PY) -m mpi_blockchain_tpu.resilience smoke \
+	    2>/dev/null || { echo "chaos-smoke: failed"; exit 1; }; \
+	echo "chaos-smoke: ok"
 
 # Perfwatch smoke: serve a faulted instrumented run, scrape /metrics +
 # /healthz live, then prove the regression sentinel flags an injected

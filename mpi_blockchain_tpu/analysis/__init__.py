@@ -21,6 +21,9 @@ classic *drift* bugs at analysis time, before any run launches:
   sim-bus events must carry ``lamport``/``node`` (i.e. go through
   ``CausalLog.record``), or the forensics merge cannot place them
   (TEL0xx rules).
+* ``resilience_lint`` — swallow-proof fault handling in dispatch/IO
+  paths: no bare ``except:`` / ``except Exception: pass`` outside the
+  sanctioned resilience policy layer (RES0xx rules).
 
 CLI: ``python -m mpi_blockchain_tpu.analysis`` — exits non-zero on any
 finding. Inline suppression: a ``chainlint: disable=RULE`` comment on the
@@ -108,6 +111,7 @@ def pass_families() -> dict[str, Callable[..., list[Finding]]]:
     from .binding_contract import run_binding_contract
     from .header_layout import run_header_layout
     from .jax_lint import run_jax_lint
+    from .resilience_lint import run_resilience_lint
     from .sanitizers import run_sanitizers
     from .telemetry_lint import run_telemetry_lint
     return {
@@ -116,6 +120,7 @@ def pass_families() -> dict[str, Callable[..., list[Finding]]]:
         "jax": run_jax_lint,
         "sanitizers": run_sanitizers,
         "telemetry": run_telemetry_lint,
+        "resilience": run_resilience_lint,
     }
 
 

@@ -1,0 +1,142 @@
+"""RES rules — swallow-proof fault handling in dispatch/IO paths.
+
+The resilience layer's whole premise is that dispatch and I/O failures
+reach ONE sanctioned decision point (``resilience/policy.py``'s
+``call_with_retry`` — retry, degrade, or raise ``RetryExhausted``)
+instead of dying silently where they happened. The classic drift bug is
+a future edit dropping an ``except Exception: pass`` around a device
+call or a checkpoint write "to be safe" — which converts a detectable
+fault into silent corruption or a silent stall, the exact failure class
+ISSUE 5 exists to kill.
+
+  RES001  in a dispatch/IO-path module, an exception handler that
+          swallows broadly: a bare ``except:`` (catches SystemExit /
+          KeyboardInterrupt) that does not re-raise, or an
+          ``except Exception:`` / ``except BaseException:`` (alone or
+          in a tuple) whose body is only ``pass`` / ``continue`` /
+          ``...``. Handle the specific exception, let it propagate to
+          the policy layer, or at minimum record it (a counter, an
+          event, a warning) before moving on.
+
+Scope: the dispatch/IO surface — ``backend/``, ``core/build.py``,
+``core/_ctypes_binding.py``, ``utils/checkpoint.py``,
+``simulation.py``, ``models/``, ``parallel/distributed.py`` (override
+key ``resilience_files`` — the drift-fixture seam). The sanctioned
+swallow point ``resilience/policy.py`` is deliberately outside the
+scope.
+"""
+from __future__ import annotations
+
+import ast
+import pathlib
+
+from . import Finding
+
+#: Repo-relative dispatch/IO paths RES001 covers (files or directories).
+DISPATCH_IO_PATHS = (
+    "mpi_blockchain_tpu/backend",
+    "mpi_blockchain_tpu/core/build.py",
+    "mpi_blockchain_tpu/core/_ctypes_binding.py",
+    "mpi_blockchain_tpu/utils/checkpoint.py",
+    "mpi_blockchain_tpu/simulation.py",
+    "mpi_blockchain_tpu/models",
+    "mpi_blockchain_tpu/parallel/distributed.py",
+)
+
+_BROAD = ("Exception", "BaseException")
+
+
+def _expr_name(node: ast.expr) -> str | None:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):   # builtins.Exception etc.
+        return node.attr
+    return None
+
+
+def _catches_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True   # bare except:
+    if isinstance(t, ast.Tuple):
+        return any(_expr_name(e) in _BROAD for e in t.elts)
+    return _expr_name(t) in _BROAD
+
+
+def _body_swallows(body: list[ast.stmt]) -> bool:
+    """True when the handler body does nothing observable: only pass /
+    continue / bare `...` — no raise, no logging, no assignment."""
+    for stmt in body:
+        if isinstance(stmt, (ast.Pass, ast.Continue)):
+            continue
+        if isinstance(stmt, ast.Expr) and \
+                isinstance(stmt.value, ast.Constant) and \
+                stmt.value.value is Ellipsis:
+            continue
+        return False
+    return True
+
+
+def _reraises(body: list[ast.stmt]) -> bool:
+    return any(isinstance(n, ast.Raise)
+               for stmt in body for n in ast.walk(stmt))
+
+
+def _scan_file(root: pathlib.Path, path: pathlib.Path) -> list[Finding]:
+    rel = (str(path.relative_to(root)) if path.is_relative_to(root)
+           else str(path))
+    try:
+        tree = ast.parse(path.read_text(), filename=str(path))
+    except SyntaxError as e:
+        return [Finding(rel, e.lineno or 1, "RES000",
+                        f"syntax error: {e.msg}")]
+    except OSError:
+        return []
+    findings = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if node.type is None:
+            # A bare except that re-raises is a (crude) cleanup idiom;
+            # one that does not is a black hole for SIGINT and bugs.
+            if not _reraises(node.body):
+                findings.append(Finding(
+                    rel, node.lineno, "RES001",
+                    "bare 'except:' in a dispatch/IO path swallows "
+                    "everything incl. KeyboardInterrupt — catch the "
+                    "specific exception or route it through the "
+                    "resilience policy layer (call_with_retry)"))
+        elif _catches_broad(node) and _body_swallows(node.body):
+            findings.append(Finding(
+                rel, node.lineno, "RES001",
+                "'except Exception: pass' in a dispatch/IO path turns a "
+                "detectable fault into silent corruption/stall — handle "
+                "it, record it (counter/event), or let it reach the "
+                "resilience policy layer"))
+    return findings
+
+
+def _scoped_files(root: pathlib.Path) -> list[pathlib.Path]:
+    files: list[pathlib.Path] = []
+    for entry in DISPATCH_IO_PATHS:
+        p = root / entry
+        if p.is_dir():
+            files.extend(sorted(q for q in p.rglob("*.py")
+                                if "__pycache__" not in q.parts))
+        elif p.exists():
+            files.append(p)
+    return files
+
+
+def run_resilience_lint(root: pathlib.Path, overrides=None,
+                        notes=None) -> list[Finding]:
+    overrides = overrides or {}
+    files = overrides.get("resilience_files")
+    if files is None:
+        files = _scoped_files(root)
+    elif isinstance(files, (str, pathlib.Path)):
+        files = [pathlib.Path(files)]
+    findings: list[Finding] = []
+    for path in files:
+        findings.extend(_scan_file(root, pathlib.Path(path)))
+    return findings

@@ -60,25 +60,65 @@ def get_backend(name: str, **kwargs) -> MinerBackend:
 
 
 def backend_from_config(config, cpu_ranks: int | None = None,
-                        mesh=None) -> MinerBackend:
+                        mesh=None, resilient: bool = True) -> MinerBackend:
     """The one place a MinerConfig becomes a backend instance (shared by
     Miner, FusedMiner's rollover path, and SimNode). cpu_ranks overrides
     the CPU thread-rank count (SimNode runs each group as one rank);
-    mesh passes an explicit device mesh through to the TPU backend."""
-    if config.backend == "cpu":
-        return get_backend("cpu",
-                           n_ranks=(config.n_miners if cpu_ranks is None
-                                    else cpu_ranks),
-                           batch_size=config.batch_size)
-    return get_backend("tpu", batch_pow2=config.effective_batch_pow2,
-                       n_miners=config.n_miners, kernel=config.kernel,
-                       mesh=mesh)
+    mesh passes an explicit device mesh through to the TPU backend.
+
+    By default the instance is wrapped in the resilience layer's
+    ``ResilientBackend``: retry-with-backoff around every dispatch,
+    host-side re-validation of every winner, and the degradation ladder
+    (device kernel → jnp → native CPU) on repeated failure — see
+    docs/resilience.md. ``resilient=False`` returns the raw rung
+    (equivalence tests and benchmarks that must measure one backend).
+    """
+    if not resilient:
+        if config.backend == "cpu":
+            return get_backend("cpu",
+                               n_ranks=(config.n_miners if cpu_ranks is None
+                                        else cpu_ranks),
+                               batch_size=config.batch_size)
+        return get_backend("tpu", batch_pow2=config.effective_batch_pow2,
+                           n_miners=config.n_miners, kernel=config.kernel,
+                           mesh=mesh)
+    from ..resilience.dispatch import ResilientBackend, ladder_from_config
+    return ResilientBackend(ladder_from_config(config, cpu_ranks=cpu_ranks,
+                                               mesh=mesh),
+                            seed=config.seed)
+
+
+def _faulted_result(fault, res: SearchResult,
+                    start_nonce: int) -> SearchResult:
+    """Applies a dispatch-site ``corrupt``/``partial`` fault to a search
+    result (shared by the cpu and tpu hooks, docs/resilience.md):
+
+    * ``corrupt`` — the result LIES: a found winner keeps its nonce but
+      reports a damaged digest; an empty sweep fabricates a bogus
+      winner. Either way host-side re-validation (ResilientBackend)
+      must catch it — corruption is injected *detectably wrong*.
+    * ``partial`` — the result is TRUNCATED: any winner is suppressed
+      and only half the sweep is credited, the lost-result fault.
+    """
+    if fault.kind == "partial":
+        return SearchResult(None, None, max(0, res.hashes_tried // 2))
+    if fault.kind == "corrupt":
+        if res.nonce is not None:
+            bad = bytes(b ^ 0xFF for b in res.hash) if res.hash else b"\xff" * 32
+            return dataclasses.replace(res, hash=bad)
+        return SearchResult(start_nonce & 0xFFFFFFFF, b"\x00" * 32,
+                            res.hashes_tried)
+    return res
 
 
 def available() -> list[str]:
     from . import cpu  # noqa: F401
     try:
         from . import tpu  # noqa: F401
-    except Exception:   # jax missing/broken — cpu still works
-        pass
+    except Exception as e:   # jax missing/broken — cpu still works
+        # Loud, not swallowed (chainlint RES001): the probe failure is
+        # an event a post-mortem can see, not a silent capability hole.
+        from ..telemetry.events import emit_event
+        emit_event({"event": "backend_probe_failed", "backend": "tpu",
+                    "error": f"{type(e).__name__}: {e}"})
     return sorted(_REGISTRY)

@@ -26,9 +26,10 @@ from __future__ import annotations
 import numpy as np
 
 from .. import core
+from ..resilience import injection
 from ..telemetry import counter
 from ..telemetry.spans import span
-from . import MinerBackend, SearchResult, register
+from . import MinerBackend, SearchResult, _faulted_result, register
 
 NONCE_SPACE = 1 << 32
 
@@ -85,6 +86,19 @@ class TpuBackend(MinerBackend):
     def search(self, header80: bytes, difficulty_bits: int,
                start_nonce: int = 0, max_count: int = NONCE_SPACE
                ) -> SearchResult:
+        # Fault-injection hook: raise/hang fire before any device work
+        # (a dead dispatch costs no compile); corrupt/partial damage the
+        # completed result (docs/resilience.md).
+        fault = injection.check("backend.tpu.dispatch",
+                                difficulty=difficulty_bits)
+        res = self._search_device(header80, difficulty_bits, start_nonce,
+                                  max_count)
+        if fault is not None:
+            res = _faulted_result(fault, res, start_nonce)
+        return res
+
+    def _search_device(self, header80: bytes, difficulty_bits: int,
+                       start_nonce: int, max_count: int) -> SearchResult:
         from ..parallel.mesh import replicated_host_values
 
         midstate, tail = core.header_midstate(header80)

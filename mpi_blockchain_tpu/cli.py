@@ -18,6 +18,7 @@ import sys
 import time
 
 from .config import ConfigError, MinerConfig, PRESETS
+from .resilience import FaultPlanError, RetryExhausted
 
 
 def _batch_pow2_arg(s: str):
@@ -60,6 +61,13 @@ def _add_metrics_dump_arg(p: argparse.ArgumentParser) -> None:
                         "the duration of the run (0 = ephemeral port, "
                         "announced on stderr; env MPIBT_METRICS_PORT also "
                         "enables it)")
+    p.add_argument("--fault-plan", metavar="PATH|seed:N", default=None,
+                   help="arm the deterministic fault-injection harness "
+                        "with a JSON fault plan (or a seed-derived one); "
+                        "env MPIBT_FAULT_PLAN also arms it. Exit codes: "
+                        "0 converged (possibly degraded, warned), "
+                        "2 retries exhausted, 3 plan invalid/unexhausted "
+                        "(docs/resilience.md)")
 
 
 def _config_from(args) -> MinerConfig:
@@ -82,7 +90,16 @@ def _init_world(args, cfg):
     import jax
 
     from .parallel.distributed import init_distributed, make_global_miner_mesh
-    init_distributed(args.coordinator, args.num_processes, args.process_id)
+    from .resilience.policy import call_with_retry
+
+    # A wedged coordinator or a slow-to-bind peer is the classic
+    # transient launch fault: retry under the distributed.init budget
+    # (capped exponential backoff, deterministic jitter) before giving
+    # up with RetryExhausted (rc 2).
+    call_with_retry(
+        lambda: init_distributed(args.coordinator, args.num_processes,
+                                 args.process_id),
+        site="distributed.init")
     mesh = make_global_miner_mesh()
     cfg = dataclasses.replace(cfg, backend="tpu",
                               n_miners=len(jax.devices()))
@@ -90,12 +107,23 @@ def _init_world(args, cfg):
 
 
 def _load_resume(path: str, cfg, mesh):
-    """Loads the --resume checkpoint. Returns (node, error_or_None)."""
-    from .utils.checkpoint import load_chain
+    """Loads the --resume checkpoint, recovering a torn tail if needed.
+    Returns (node, error_or_None, recovery_report)."""
+    from .utils.checkpoint import recover_chain
 
-    node, err = None, None
+    from .resilience import RetryExhausted as _RetryExhausted
+    from .resilience.policy import call_with_retry
+
+    node, err, report = None, None, {}
     try:
-        node = load_chain(path, cfg.difficulty_bits)
+        # The checkpoint.read budget covers transient FS errors; real
+        # integrity damage is CheckpointError (never retried) and goes
+        # through recover_chain's truncation path instead.
+        node, report = call_with_retry(
+            lambda: recover_chain(path, cfg.difficulty_bits),
+            site="checkpoint.read")
+    except _RetryExhausted as e:
+        err = str(e.last)
     except (OSError, ValueError) as e:
         err = str(e)
     if mesh is not None:
@@ -114,7 +142,7 @@ def _load_resume(path: str, cfg, mesh):
         if not (rows == rows[0]).all():
             err = (f"resume state diverges across processes "
                    f"(this process: {err or 'ok'})")
-    return node, err
+    return node, err, report
 
 
 def cmd_mine(args) -> int:
@@ -137,22 +165,76 @@ def cmd_mine(args) -> int:
     else:
         miner = Miner(cfg)
     if args.resume:
-        node, err = _load_resume(args.resume, cfg, mesh)
+        node, err, report = _load_resume(args.resume, cfg, mesh)
         if err is not None:
             print(json.dumps({"event": "chain_mined", "error": err},
                              sort_keys=True))
             return 1
         miner.node = node
+        # Replay the progress heartbeat at the resumed height BEFORE the
+        # first (possibly slow) sweep, so perfwatch /healthz sees the
+        # recovery as live progress, not a stall inherited from the
+        # crashed run.
+        from .telemetry import heartbeat
+        from .telemetry.events import emit_event
+        heartbeat("miner_heartbeat").set(node.height)
+        emit_event({"event": "checkpoint_resumed", "height": node.height,
+                    "recovered": report.get("recovered", False),
+                    "dropped_bytes": report.get("dropped_bytes", 0)})
+        if report.get("recovered"):
+            if report.get("dropped_bytes"):
+                print(f"resume: torn checkpoint tail truncated to last "
+                      f"valid block (height {node.height}, "
+                      f"{report['dropped_bytes']} chain bytes dropped)",
+                      file=sys.stderr)
+            else:
+                print(f"resume: checkpoint seal repaired (height "
+                      f"{node.height}, no chain bytes lost)",
+                      file=sys.stderr)
     # --blocks is the TARGET height, so a resumed run mines the remainder
     # (equal to "blocks to mine" when starting from genesis).
     remaining = max(0, cfg.n_blocks - miner.node.height)
+    on_block = None
+    if args.checkpoint_every:
+        if args.checkpoint_every < 0:
+            raise ConfigError(f"--checkpoint-every must be >= 1, "
+                              f"got {args.checkpoint_every}")
+        if not args.checkpoint:
+            raise ConfigError("--checkpoint-every needs --checkpoint PATH "
+                              "(where to save)")
+        from .resilience.policy import call_with_retry
+        from .utils.checkpoint import save_chain as _periodic_save
+        every = args.checkpoint_every
+
+        def on_block(rec):
+            # Retry transient FS errors under the checkpoint.write
+            # budget — a periodic save must not kill a long mining run.
+            if rec.height % every == 0:
+                call_with_retry(
+                    lambda: _periodic_save(miner.node, args.checkpoint,
+                                           cfg),
+                    site="checkpoint.write")
+        if not is_main:
+            # Multi-process world: every rank mines the identical chain,
+            # so only the main process writes the shared checkpoint —
+            # N ranks racing os.replace on one path could publish a
+            # payload/sidecar pair from different heights.
+            on_block = None
     profile_ctx = contextlib.nullcontext()
     if args.profile:
         from .utils.profiling import trace_mining
         profile_ctx = trace_mining(args.profile)
     t0 = time.perf_counter()
     with profile_ctx:
-        miner.mine_chain(remaining)
+        if args.fused:
+            # The fused loop appends whole device spans; checkpoint at
+            # span boundaries (every span IS >= 1 block of progress).
+            miner.mine_chain(remaining, on_progress=(
+                (lambda height: _periodic_save(miner.node,
+                                               args.checkpoint, cfg))
+                if on_block is not None else None))
+        else:
+            miner.mine_chain(remaining, on_block=on_block)
     wall = time.perf_counter() - t0
     if not is_main:      # non-zero processes mine but stay silent
         return 0
@@ -174,13 +256,27 @@ def cmd_mine(args) -> int:
         summary.update(hashes_tried=miner.total_hashes(),
                        hashes_per_sec=round(miner.hashes_per_sec()),
                        backend=miner.backend.name)
+    degradations = getattr(getattr(miner, "backend", None),
+                           "degradations", [])
+    if degradations:
+        # "Converged after degradation": rc 0, but loudly — the run
+        # finished on a lower ladder rung than it was asked for.
+        summary["degraded"] = True
+        summary["degraded_to"] = degradations[-1]["to"]
+        print(f"warning: backend degraded "
+              f"{' -> '.join(d['to'] for d in degradations)} "
+              f"after repeated dispatch failure; run converged anyway",
+              file=sys.stderr)
     print(json.dumps(summary, sort_keys=True))
     return 0
 
 
 def cmd_verify(args) -> int:
-    """Validates a saved chain file (PoW + linkage + determinism rules)."""
+    """Validates a saved chain file (PoW + linkage + determinism rules).
+    Accepts both raw header files (--out) and sealed checkpoints
+    (--checkpoint carries an integrity trailer, which is verified)."""
     from . import core
+    from .utils.checkpoint import CheckpointError, open_checkpoint
 
     try:
         with open(args.chain, "rb") as f:
@@ -189,10 +285,21 @@ def cmd_verify(args) -> int:
         print(json.dumps({"event": "chain_verified", "valid": False,
                           "error": str(e)}, sort_keys=True))
         return 1
+    try:
+        # The full integrity gate (trailer + sidecar): a torn sealed
+        # checkpoint must read as invalid here, never as a valid
+        # shorter chain.
+        payload, sealed, _ = open_checkpoint(args.chain, blob)
+    except CheckpointError as e:
+        print(json.dumps({"event": "chain_verified", "valid": False,
+                          "sealed": True, "error": str(e)},
+                         sort_keys=True))
+        return 1
     node = core.Node(args.difficulty, 0)
-    ok = node.load(blob)
+    ok = node.load(payload)
     print(json.dumps({
         "event": "chain_verified", "valid": bool(ok),
+        "sealed": sealed,
         "height": node.height if ok else None,
         "tip_hash": node.tip_hash.hex() if ok else None,
     }, sort_keys=True))
@@ -265,9 +372,15 @@ def cmd_sim(args) -> int:
         return 1
     _dump_events()
     tips = {n.node.tip_hash.hex() for n in net.nodes}
+    degradations = [d for n in net.nodes
+                    for d in getattr(n.backend, "degradations", [])]
+    if degradations:
+        print(f"warning: {len(degradations)} backend degradation(s) "
+              f"during the sim; converged anyway", file=sys.stderr)
     out = {
         "event": "sim_done",
         "converged": net.converged(),
+        "degraded": bool(degradations),
         "steps": net.step_count,
         "heights": [n.node.height for n in net.nodes],
         "tips": sorted(tips),
@@ -330,9 +443,18 @@ def main(argv: list[str] | None = None) -> int:
                              "(one device call per --blocks-per-call)")
     p_mine.add_argument("--blocks-per-call", type=int, default=16)
     p_mine.add_argument("--checkpoint",
-                        help="save the chain + config sidecar here when done")
+                        help="save the chain + config sidecar here when done "
+                             "(atomic write + integrity trailer)")
+    p_mine.add_argument("--checkpoint-every", type=int, default=0,
+                        metavar="N",
+                        help="also save --checkpoint every N mined blocks "
+                             "(every device span with --fused), so a "
+                             "SIGKILL loses at most N blocks; resume with "
+                             "--resume")
     p_mine.add_argument("--resume",
-                        help="load this checkpoint and mine up to --blocks")
+                        help="load this checkpoint (verifying integrity; "
+                             "a torn tail is truncated to the last valid "
+                             "block) and mine up to --blocks")
     p_mine.add_argument("--profile",
                         help="capture a jax.profiler device trace into this "
                              "logdir (view with ui.perfetto.dev)")
@@ -421,6 +543,12 @@ def main(argv: list[str] | None = None) -> int:
         from .telemetry import flight_recorder
         flight_recorder.install(fr_path)
         flight_recorder.register_context(command=args.command)
+    fault_arg = getattr(args, "fault_plan", None)
+    if fault_arg is None and hasattr(args, "fault_plan"):
+        # Env fallback only for subcommands that take the flag
+        # (mine/sim/bench) — same scoping rule as MPIBT_METRICS_PORT.
+        fault_arg = os.environ.get("MPIBT_FAULT_PLAN") or None
+    plan_armed = False
     metrics_port = getattr(args, "serve_metrics", None)
     if metrics_port is None and hasattr(args, "serve_metrics"):
         # Env fallback only for the subcommands that take the flag
@@ -445,7 +573,39 @@ def main(argv: list[str] | None = None) -> int:
                   f"(/metrics /healthz /events)", file=sys.stderr,
                   flush=True)
     try:
-        return args.fn(args)
+        if fault_arg:
+            from .resilience import injection
+            from .resilience.faultplan import FaultPlan
+            injection.arm(FaultPlan.parse_arg(fault_arg))
+            plan_armed = True
+            print(f"fault plan armed: {fault_arg}", file=sys.stderr,
+                  flush=True)
+        rc = args.fn(args)
+        if plan_armed:
+            # Strict plans demand every fault actually fired; an
+            # unexhausted plan is its own failure class (rc 3), distinct
+            # from both convergence (0) and exhausted retries (2). The
+            # check only gates SUCCESSFUL runs: a run that already
+            # failed (rc != 0) keeps its own exit code — an unfired
+            # fault must never mask the run's own failure.
+            plan_armed = False
+            from .resilience import injection
+            injection.disarm(strict=(rc == 0))
+        return rc
+    except FaultPlanError as e:
+        # Before ConfigError: FaultPlanError subclasses it, and CI must
+        # be able to tell "bad/unexhausted fault plan" (3) from "bad
+        # config / exhausted retries" (2).
+        print(json.dumps({"event": "error", "kind": "fault_plan",
+                          "error": str(e)}, sort_keys=True))
+        return 3
+    except RetryExhausted as e:
+        # The policy layer gave up after every attempt and every ladder
+        # rung: a clean, distinguishable failure — not a traceback.
+        print(json.dumps({"event": "error", "kind": "retry_exhausted",
+                          "site": e.site, "error": str(e)},
+                         sort_keys=True))
+        return 2
     except ConfigError as e:
         # Config/topology errors (oversubscribed mesh, bad kernel/batch,
         # invalid checkpoint) surface as one clean JSON line, not a
@@ -457,6 +617,11 @@ def main(argv: list[str] | None = None) -> int:
                          sort_keys=True))
         return 2
     finally:
+        if plan_armed:
+            # Error paths disarm WITHOUT the strict check: an unfired
+            # fault must never mask the run's own failure.
+            from .resilience import injection
+            injection.disarm()
         # Dump on EVERY exit path, rc != 0 and raises included (e.g. a
         # non-converged sim or an exhausted nonce space): the metrics of
         # a failed run are exactly what a post-mortem needs. A dump

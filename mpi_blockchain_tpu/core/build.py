@@ -26,8 +26,21 @@ def _stale(artifact: pathlib.Path) -> bool:
     return any(p.stat().st_mtime > mtime for p in _SRC.iterdir())
 
 
+def _fault_check(site: str, **ctx) -> None:
+    """native.load injection hook. This module is sometimes loaded
+    standalone (spec_from_file_location, no parent package — the
+    binding-fallback test does), where relative imports cannot resolve;
+    fall back to the absolute form."""
+    try:
+        from ..resilience import injection
+    except ImportError:
+        from mpi_blockchain_tpu.resilience import injection
+    injection.check(site, **ctx)
+
+
 def ensure_built() -> pathlib.Path:
     """Compiles the ctypes C ABI library if missing or out of date."""
+    _fault_check("native.load", artifact="libchaincore")
     if _stale(_LIB):
         subprocess.run(["make", "-s"], cwd=_CORE_DIR, check=True)
     return _LIB
@@ -67,7 +80,10 @@ def ensure_pybind_built():
 
     Raises on any failure — the caller (core/__init__.py) decides whether
     to fall back to ctypes or surface the error (MBT_BINDING=pybind11).
+    A ``native.load`` fault here exercises exactly that auto-fallback
+    seam: the injected failure must degrade to ctypes loudly, not die.
     """
+    _fault_check("native.load", artifact="chaincore_pb")
     path = pybind_module_path()
     if _stale(path):
         subprocess.run(

@@ -173,12 +173,20 @@ class FusedMiner:
             jax.ShapeDtypeStruct((k, 8), u32),
             jax.ShapeDtypeStruct((), u32)).compile()
 
-    def mine_chain(self, n_blocks: int | None = None) -> None:
-        """Mines n_blocks; validates + appends every block in C++."""
+    def mine_chain(self, n_blocks: int | None = None,
+                   on_progress=None) -> None:
+        """Mines n_blocks; validates + appends every block in C++.
+
+        ``on_progress(height)`` runs after each appended span — the
+        fused form of the per-block miner's checkpoint seam (the span,
+        not the block, is the natural crash-recovery granule here).
+        """
         n = n_blocks if n_blocks is not None else self.config.n_blocks
         while n > 0:
             mined = self._mine_span(n)
             n -= mined
+            if on_progress is not None and mined:
+                on_progress(self.node.height)
 
     def _mine_span(self, n: int) -> int:
         """Dispatches ceil(n / blocks_per_call) fused device calls

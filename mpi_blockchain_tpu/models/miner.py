@@ -109,10 +109,23 @@ class Miner:
                    **dataclasses.asdict(rec)})
         return rec
 
-    def mine_chain(self, n_blocks: int | None = None) -> list[BlockRecord]:
-        """Mines n_blocks on top of the current tip (config 1/3/4 driver)."""
+    def mine_chain(self, n_blocks: int | None = None,
+                   on_block: Callable[[BlockRecord], None] | None = None
+                   ) -> list[BlockRecord]:
+        """Mines n_blocks on top of the current tip (config 1/3/4 driver).
+
+        ``on_block`` runs after each append — the periodic-checkpoint
+        seam (``mine --checkpoint-every N`` saves the chain here, so a
+        SIGKILL mid-run loses at most N blocks; docs/resilience.md).
+        """
         n = n_blocks if n_blocks is not None else self.config.n_blocks
-        return [self.mine_block() for _ in range(n)]
+        records = []
+        for _ in range(n):
+            rec = self.mine_block()
+            records.append(rec)
+            if on_block is not None:
+                on_block(rec)
+        return records
 
     # ---- aggregate metrics -------------------------------------------------
 

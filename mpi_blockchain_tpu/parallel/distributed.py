@@ -25,7 +25,13 @@ def init_distributed(coordinator_address: str | None = None,
 
     With no arguments, jax.distributed.initialize auto-discovers the TPU pod
     topology from the environment (the standard v5e multi-host launch).
+    Callers that want the wedged-coordinator case survivable wrap this in
+    ``resilience.call_with_retry(site="distributed.init")`` — the CLI's
+    ``_init_world`` does (docs/resilience.md).
     """
+    from ..resilience import injection
+    injection.check("distributed.init",
+                    coordinator=str(coordinator_address))
     jax.distributed.initialize(coordinator_address=coordinator_address,
                                num_processes=num_processes,
                                process_id=process_id)

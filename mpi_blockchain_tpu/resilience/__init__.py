@@ -1,0 +1,72 @@
+"""Resilience: deterministic fault injection, retry/fallback dispatch,
+and crash-safe recovery (ISSUE 5; arxiv 1804.08230 §worker failure,
+VaultxGPU's recovery-first consensus design).
+
+Four PRs built eyes (chainlint, telemetry, forensics, perfwatch); this
+package keeps the system ALIVE long enough for those eyes to matter:
+
+* **faultplan** — a seeded, byte-reproducible fault-plan spec
+  (``FaultPlan``): which injection site, which call index, which fault
+  class (``raise`` / ``hang`` / ``corrupt`` / ``partial``). Armed via
+  ``--fault-plan PATH|seed:N`` (env ``MPIBT_FAULT_PLAN``) on
+  mine/sim/bench, so any failure mode replays byte-for-byte.
+* **injection** — the process-global arming point. Hooks threaded into
+  backend dispatch (``backend/tpu.py`` / ``backend/cpu.py``), the
+  simulation bus (``simulation.Network.deliver_due``), native-lib load
+  (``core/build.py``), checkpoint I/O (``utils/checkpoint.py``) and
+  distributed init call ``injection.check(site)`` and either crash,
+  wedge, or hand back a fault for site-specific damage.
+* **policy** — capped exponential backoff with deterministic jitter
+  (seeded, no global RNG), per-layer budgets, and ``RetryExhausted``
+  as the one loud give-up signal (CLI rc 2).
+* **dispatch** — ``ResilientBackend``: the graceful-degradation ladder
+  fused/pallas kernel → jnp sweep → native CPU miner. Every returned
+  winner is re-validated host-side (two SHA-256 compressions), so a
+  corrupt device result is a *detected* fault, not a poisoned chain.
+  Degradation emits a ``backend_degraded`` event + gauge and keeps
+  mining instead of crashing.
+
+Crash-safe checkpointing lives in ``utils/checkpoint.py`` (atomic
+write + length/SHA-256 trailer + torn-tail recovery); the chaos gate is
+``python -m mpi_blockchain_tpu.resilience smoke`` (``make chaos-smoke``).
+Semantics: docs/resilience.md. Standard library only — importing this
+package never pulls in jax.
+"""
+from __future__ import annotations
+
+from ..config import ConfigError
+
+
+class FaultInjected(RuntimeError):
+    """An injected fault fired (kind=raise, or site-specific damage that
+    surfaces as an exception). Carries the site/kind for forensics."""
+
+    def __init__(self, site: str, kind: str, message: str = ""):
+        self.site = site
+        self.kind = kind
+        super().__init__(message or f"injected fault at {site} ({kind})")
+
+
+class FaultTimeout(FaultInjected):
+    """A simulated hang exceeded its watchdog budget (kind=hang)."""
+
+
+class FaultPlanError(ConfigError):
+    """Invalid or unexhausted fault plan (CLI rc 3): unparseable spec,
+    unknown site/kind, or — under ``strict`` — faults that never fired."""
+
+
+class RetryExhausted(RuntimeError):
+    """A policy-wrapped call failed on every attempt and every ladder
+    rung below it (CLI rc 2). ``last`` keeps the final cause."""
+
+    def __init__(self, site: str, attempts: int, last: BaseException):
+        self.site = site
+        self.attempts = attempts
+        self.last = last
+        super().__init__(f"{site}: exhausted {attempts} attempt(s); "
+                         f"last error: {type(last).__name__}: {last}")
+
+
+from .faultplan import FaultPlan, FaultSpec  # noqa: E402,F401
+from .policy import RetryPolicy, call_with_retry, policy_for  # noqa: E402,F401

@@ -1,0 +1,181 @@
+"""CLI: ``python -m mpi_blockchain_tpu.resilience {smoke,plan}``.
+
+``smoke`` is the ``make chaos-smoke`` gate — the acceptance proof of
+ISSUE 5, three phases, all against the REAL CLI surface:
+
+1. **Determinism** — one fixed fault plan drives two identical faulted
+   sims; their causal event dumps must be byte-identical.
+2. **Kill + resume** — a real subprocess miner checkpointing every
+   block is SIGKILL'd mid-run; resume must verify, extend, and (after
+   an additional deliberate tear) truncate to the last valid block.
+3. **Degradation** — a fault plan kills every TPU dispatch; the ladder
+   must walk device → jnp → native CPU and still converge with rc 0 on
+   the byte-identical chain the CPU oracle mines.
+
+``plan --seed N`` prints the seed-derived plan ``--fault-plan seed:N``
+would arm (the fuzz harness's input, docs/resilience.md).
+"""
+from __future__ import annotations
+
+import argparse
+import contextlib
+import io
+import json
+import os
+import pathlib
+import signal
+import subprocess
+import sys
+import tempfile
+
+
+def _run_cli(argv: list[str]) -> tuple[int, dict]:
+    """Runs the real CLI in-process; returns (rc, last JSON line)."""
+    from ..cli import main as cli_main
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = cli_main(argv)
+    lines = [ln for ln in buf.getvalue().splitlines() if ln.strip()]
+    return rc, (json.loads(lines[-1]) if lines else {})
+
+
+def smoke_determinism(tmp: pathlib.Path) -> str:
+    plan = {"version": 1, "seed": 5, "faults": [
+        {"site": "sim.deliver", "kind": "corrupt", "call": 2, "times": 2},
+        {"site": "sim.deliver", "kind": "partial", "call": 7, "times": 3},
+        {"site": "backend.cpu.search", "kind": "partial", "call": 5,
+         "times": 2},
+    ]}
+    plan_path = tmp / "plan.json"
+    plan_path.write_text(json.dumps(plan))
+    for i in range(2):
+        rc, out = _run_cli(["sim", "--blocks", "4", "--partition-steps",
+                            "10", "--drop-rate", "10", "--seed", "3",
+                            "--fault-plan", str(plan_path),
+                            "--events-dump", str(tmp / f"dump{i}.json")])
+        assert rc == 0, f"faulted sim run {i} rc={rc}: {out}"
+        assert out.get("converged") is True, out
+    b0 = (tmp / "dump0.json").read_bytes()
+    b1 = (tmp / "dump1.json").read_bytes()
+    assert b0 == b1, (f"fixed-seed fault plan produced DIVERGING causal "
+                      f"dumps ({len(b0)} vs {len(b1)} bytes)")
+    return (f"determinism ok ({len(plan['faults'])} faults, "
+            f"{len(b0)}-byte causal dump byte-identical across 2 runs)")
+
+
+def smoke_kill_resume(tmp: pathlib.Path) -> str:
+    ck = tmp / "ck.bin"
+    repo_root = pathlib.Path(__file__).resolve().parents[2]
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=os.pathsep.join(
+                   p for p in (str(repo_root),
+                               os.environ.get("PYTHONPATH")) if p))
+    # --checkpoint-every 1 fsyncs per block: plenty of runway to SIGKILL
+    # long before the 4000-block target.
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "mpi_blockchain_tpu", "mine",
+         "--difficulty", "10", "--blocks", "4000", "--backend", "cpu",
+         "--checkpoint", str(ck), "--checkpoint-every", "1", "--verbose"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, cwd=str(tmp))
+    mined = 0
+    for line in proc.stdout:
+        if '"block_mined"' in line:
+            mined += 1
+            if mined >= 3:
+                break
+    os.kill(proc.pid, signal.SIGKILL)
+    proc.stdout.close()
+    proc.wait()
+    assert mined >= 3, "miner died before mining 3 blocks"
+    sidecar = json.loads(ck.with_suffix(".bin.json").read_text())
+    height = sidecar["height"]
+    # The SIGKILL can land between a block's log line and its save:
+    # --checkpoint-every 1 guarantees at most ONE block of loss.
+    assert height >= mined - 1 >= 2, (mined, sidecar)
+    # (a) Straight resume: the atomic writer guarantees the published
+    # checkpoint is whole despite the SIGKILL; mine 2 more and verify.
+    out_path = tmp / "resumed.bin"
+    rc, out = _run_cli(["mine", "--difficulty", "10", "--blocks",
+                        str(height + 2), "--backend", "cpu",
+                        "--resume", str(ck), "--out", str(out_path)])
+    assert rc == 0 and out["height"] == height + 2, (rc, out)
+    rc, verdict = _run_cli(["verify", "--chain", str(out_path),
+                            "--difficulty", "10"])
+    assert rc == 0 and verdict["valid"] is True, (rc, verdict)
+    # (b) Torn tail: rip the trailer + most of the last header off, as a
+    # non-atomic writer's crash would; resume must truncate to the last
+    # valid block and still reach the target.
+    blob = ck.read_bytes()
+    ck.write_bytes(blob[:-120])
+    rc, out = _run_cli(["mine", "--difficulty", "10", "--blocks",
+                        str(height + 1), "--backend", "cpu",
+                        "--resume", str(ck)])
+    assert rc == 0 and out["height"] == height + 1, (rc, out)
+    from ..telemetry.events import recent_events
+    truncs = recent_events(event="checkpoint_truncated")
+    assert truncs and truncs[-1]["height"] == height - 1, truncs
+    return (f"kill+resume ok (SIGKILL at >= 3 blocks, checkpoint height "
+            f"{height}, resumed to {height + 2} and verified; torn tail "
+            f"truncated to {height - 1} and re-mined)")
+
+
+def smoke_degradation(tmp: pathlib.Path) -> str:
+    plan_path = tmp / "kill_tpu.json"
+    plan_path.write_text(json.dumps({"version": 1, "faults": [
+        {"site": "backend.tpu.dispatch", "kind": "raise", "call": 0,
+         "times": -1}]}))
+    rc, out = _run_cli(["mine", "--difficulty", "8", "--blocks", "2",
+                        "--backend", "tpu", "--kernel", "auto",
+                        "--batch-pow2", "11",
+                        "--fault-plan", str(plan_path)])
+    assert rc == 0, f"degraded mine must still converge rc 0, got {rc}"
+    assert out.get("degraded") is True and out["degraded_to"] == "cpu", out
+    assert out["backend"] == "cpu", out
+    rc, oracle = _run_cli(["mine", "--difficulty", "8", "--blocks", "2",
+                           "--backend", "cpu"])
+    assert rc == 0, oracle
+    assert out["tip_hash"] == oracle["tip_hash"], (
+        "degraded chain diverged from the cpu oracle chain")
+    return ("degradation ok (dead TPU dispatch walked the ladder to cpu, "
+            "rc 0, chain byte-identical to the cpu oracle)")
+
+
+def cmd_smoke(args) -> int:
+    with tempfile.TemporaryDirectory() as td:
+        tmp = pathlib.Path(td)
+        for phase in (smoke_determinism, smoke_kill_resume,
+                      smoke_degradation):
+            print(f"chaos-smoke: {phase(tmp)}", flush=True)
+    return 0
+
+
+def cmd_plan(args) -> int:
+    from .faultplan import FaultPlan
+    print(json.dumps(FaultPlan.from_seed(args.seed,
+                                         n_faults=args.faults).to_dict(),
+                     indent=2, sort_keys=True))
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m mpi_blockchain_tpu.resilience",
+        description="chaos gate: deterministic fault injection, "
+                    "kill+resume recovery, degradation ladder")
+    sub = parser.add_subparsers(dest="command", required=True)
+    p_smoke = sub.add_parser("smoke", help="run the chaos-smoke gate "
+                                           "(make chaos-smoke)")
+    p_smoke.set_defaults(fn=cmd_smoke)
+    p_plan = sub.add_parser("plan", help="print the plan --fault-plan "
+                                         "seed:N would arm")
+    p_plan.add_argument("--seed", type=int, default=0)
+    p_plan.add_argument("--faults", type=int, default=3)
+    p_plan.set_defaults(fn=cmd_plan)
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

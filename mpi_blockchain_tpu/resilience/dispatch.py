@@ -1,0 +1,175 @@
+"""ResilientBackend: retry-wrapped dispatch + the degradation ladder.
+
+The graceful-degradation ladder is the ISSUE-5 survival contract: a
+mining run whose fused/pallas kernel starts failing steps down to the
+jnp sweep, and a run whose device dispatch is gone entirely steps down
+to the native CPU miner — emitting a ``backend_degraded`` event + gauge
+and *continuing to mine* instead of crashing. Every rung implements the
+same deterministic lowest-nonce contract, so a degraded chain is
+byte-identical to the chain the dead rung would have mined (the
+equivalence suite's guarantee doing resilience work).
+
+Trust boundary: a backend result is never taken on faith. Any returned
+winner is re-validated host-side (recompute sha256d, check the
+difficulty and the reported digest) — two compressions per *block*, not
+per nonce — so a corrupt device result (bitflip, injected fault, broken
+kernel) surfaces as a retryable ``CorruptResult`` at the policy layer
+instead of poisoning the C++ Node. ``ConfigError`` is exempt from both
+retry and degradation: an explicit ``--kernel pallas`` off-TPU must
+keep failing loudly (the CLI's clean-error contract), never silently
+step down.
+"""
+from __future__ import annotations
+
+from typing import Callable
+
+from .. import core
+from ..backend import MinerBackend, SearchResult
+from ..config import ConfigError
+from ..telemetry import counter, gauge
+from ..telemetry.events import emit_event
+from . import RetryExhausted
+from .policy import RetryPolicy, call_with_retry, policy_for
+
+
+class CorruptResult(RuntimeError):
+    """A backend returned a winner that fails host-side re-validation."""
+
+
+Rung = tuple[str, Callable[[], MinerBackend]]
+
+
+def ladder_from_config(config, cpu_ranks: int | None = None,
+                       mesh=None) -> list[Rung]:
+    """The degradation ladder a MinerConfig implies, top rung first:
+    requested device kernel → jnp sweep → native CPU miner. A cpu
+    config has the single native rung (retry-only, nothing to degrade
+    to). Factories are lazy: a dead rung's replacement is only built
+    when the ladder steps down onto it."""
+    from ..backend import get_backend
+
+    n_ranks = config.n_miners if cpu_ranks is None else cpu_ranks
+
+    def cpu_factory():
+        return get_backend("cpu", n_ranks=n_ranks,
+                           batch_size=config.batch_size)
+
+    if config.backend == "cpu":
+        return [("cpu", cpu_factory)]
+
+    def tpu_factory(kernel):
+        return lambda: get_backend("tpu",
+                                   batch_pow2=config.effective_batch_pow2,
+                                   n_miners=config.n_miners,
+                                   kernel=kernel, mesh=mesh)
+
+    rungs: list[Rung] = [(f"tpu:{config.kernel}",
+                          tpu_factory(config.kernel))]
+    if config.kernel != "jnp":
+        rungs.append(("tpu:jnp", tpu_factory("jnp")))
+    rungs.append(("cpu", cpu_factory))
+    return rungs
+
+
+class ResilientBackend(MinerBackend):
+    """Wraps a ladder of backends behind the MinerBackend contract.
+
+    The top rung is constructed eagerly so construction-time config
+    errors (oversubscribed mesh, unknown backend) surface exactly where
+    they did before the wrap. ``name`` reflects the ACTIVE rung, so
+    metric labels and run summaries report what actually mined.
+    """
+
+    def __init__(self, rungs: list[Rung],
+                 policy: RetryPolicy | None = None, seed: int = 0):
+        if not rungs:
+            raise ConfigError("degradation ladder needs at least one rung")
+        self._rungs = list(rungs)
+        self._i = 0
+        self._backend = rungs[0][1]()
+        self._policy = policy
+        self._seed = seed
+        self.degradations: list[dict] = []
+
+    # ---- introspection ---------------------------------------------------
+
+    @property
+    def name(self) -> str:          # type: ignore[override]
+        return self._backend.name
+
+    @property
+    def rung(self) -> str:
+        return self._rungs[self._i][0]
+
+    @property
+    def degraded(self) -> bool:
+        return self._i > 0
+
+    @property
+    def active_backend(self) -> MinerBackend:
+        return self._backend
+
+    # ---- the plugin contract ---------------------------------------------
+
+    def search(self, header80: bytes, difficulty_bits: int,
+               start_nonce: int = 0,
+               max_count: int = 1 << 32) -> SearchResult:
+        while True:
+            label = self.rung
+            try:
+                return call_with_retry(
+                    lambda: self._checked_search(header80, difficulty_bits,
+                                                 start_nonce, max_count),
+                    site=f"dispatch.{label}",
+                    policy=(self._policy if self._policy is not None
+                            else policy_for("dispatch", seed=self._seed)))
+            except RetryExhausted as e:
+                if not self._step_down(e):
+                    raise
+
+    def _checked_search(self, header80: bytes, difficulty_bits: int,
+                        start_nonce: int, max_count: int) -> SearchResult:
+        res = self._backend.search(header80, difficulty_bits,
+                                   start_nonce=start_nonce,
+                                   max_count=max_count)
+        if res.nonce is not None:
+            digest = core.header_hash(core.set_nonce(header80, res.nonce))
+            if core.leading_zero_bits(digest) < difficulty_bits or \
+                    (res.hash is not None and res.hash != digest):
+                counter("corrupt_results_total",
+                        help="backend winners that failed host-side "
+                             "re-validation", backend=self._backend.name
+                        ).inc()
+                raise CorruptResult(
+                    f"{self.rung}: nonce {res.nonce} fails re-validation "
+                    f"(difficulty {difficulty_bits})")
+        return res
+
+    def _step_down(self, err: RetryExhausted) -> bool:
+        """Advances to the next constructible rung; False when the
+        ladder is exhausted (the caller re-raises — CLI rc 2)."""
+        while self._i + 1 < len(self._rungs):
+            old = self.rung
+            self._i += 1
+            label, factory = self._rungs[self._i]
+            try:
+                self._backend = factory()
+            except Exception as e:
+                # A rung whose CONSTRUCTION fails (jax gone, mesh dead)
+                # is skipped loudly; the ladder keeps walking down.
+                emit_event({"event": "backend_rung_unavailable",
+                            "rung": label,
+                            "error": f"{type(e).__name__}: {e}"})
+                continue
+            record = {"event": "backend_degraded", "from": old,
+                      "to": label, "rung_index": self._i,
+                      "error": str(err)}
+            self.degradations.append(record)
+            counter("backend_degradations_total",
+                    help="ladder step-downs after exhausted retries").inc()
+            gauge("backend_degraded",
+                  help="active degradation-ladder rung index "
+                       "(0 = requested backend)").set(self._i)
+            emit_event(record)
+            return True
+        return False

@@ -1,0 +1,128 @@
+"""The process-global fault-injection arming point.
+
+One armed ``FaultPlan`` at a time; every instrumented layer calls
+``check(site)`` on each dispatch/IO attempt. Unarmed, the check is a
+single lock-free attribute read — the production fast path costs one
+``is None`` test. Armed, each site keeps a call counter (reset at arm
+time, so runs are reproducible) and a matching fault either
+
+* raises here (``raise`` — ``FaultInjected``; ``hang`` — a real
+  ``time.sleep(seconds)`` so heartbeats go stale and /healthz flips,
+  then ``FaultTimeout``), or
+* is returned to the hook, which applies the site-specific damage
+  (``corrupt`` / ``partial`` mean different things to a bus delivery
+  than to a checkpoint write — see docs/resilience.md).
+
+Every fired fault is counted (``faults_injected_total{site,kind}``)
+and emitted as a ``fault_injected`` event, so the flight recorder and
+the perfwatch /events tail show exactly which injections a post-mortem
+run absorbed.
+
+This module must stay importable from ``core/build.py`` (the native-load
+hook), so it imports only the standard library + telemetry (stdlib-only
+by contract) — never jax, never core.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+from . import FaultInjected, FaultPlanError, FaultTimeout
+from .faultplan import FaultPlan, FaultSpec
+
+_lock = threading.Lock()
+_plan: FaultPlan | None = None
+_counts: dict[str, int] = {}
+_fired: dict[int, int] = {}   # fault index in plan -> times fired
+
+
+def arm(plan: FaultPlan) -> None:
+    """Arms ``plan`` process-wide and resets all site call counters —
+    arming is the reproducibility epoch."""
+    global _plan
+    with _lock:
+        _plan = plan
+        _counts.clear()
+        _fired.clear()
+
+
+def disarm(strict: bool = False) -> None:
+    """Disarms. With ``strict=True`` and a strict plan, raises
+    ``FaultPlanError`` if any fault never fired (the run ended without
+    exhausting the plan — the injected scenario was not exercised)."""
+    global _plan
+    with _lock:
+        plan, fired = _plan, dict(_fired)
+        _plan = None
+        _counts.clear()
+        _fired.clear()
+    if strict and plan is not None and plan.strict:
+        unfired = [i for i in range(len(plan.faults)) if i not in fired]
+        if unfired:
+            specs = ", ".join(
+                f"#{i} {plan.faults[i].site}/{plan.faults[i].kind}"
+                f"@{plan.faults[i].call}" for i in unfired)
+            raise FaultPlanError(
+                f"fault plan not exhausted: fault(s) {specs} never fired "
+                f"(the run ended before reaching their call index)")
+
+
+def active() -> bool:
+    return _plan is not None
+
+
+def armed_plan() -> FaultPlan | None:
+    return _plan
+
+
+def call_counts() -> dict[str, int]:
+    """Per-site call counters since arming (test/forensics surface)."""
+    with _lock:
+        return dict(_counts)
+
+
+def check(site: str, **ctx) -> FaultSpec | None:
+    """The hook every instrumented layer calls once per attempt.
+
+    Returns None (no plan / no match), raises (``raise``/``hang``
+    kinds), or returns the matching ``FaultSpec`` for the hook to apply
+    (``corrupt``/``partial`` kinds). ``ctx`` fields land in the
+    ``fault_injected`` event for forensics.
+    """
+    plan = _plan
+    if plan is None:
+        return None
+    with _lock:
+        index = _counts.get(site, 0)
+        _counts[site] = index + 1
+        matched = plan.match_all(site, index)
+        # Apply the FIRST matching fault, but credit every overlapping
+        # window as fired — strict exhaustion must count shadowed specs.
+        for i, _ in matched:
+            _fired[i] = _fired.get(i, 0) + 1
+        fault = matched[0][1] if matched else None
+    if fault is None:
+        return None
+    _record(site, fault, index, ctx)
+    if fault.kind == "raise":
+        raise FaultInjected(site, "raise", fault.message)
+    if fault.kind == "hang":
+        # A real sleep, not a mock: the heartbeat gauges go stale for
+        # `seconds`, which is exactly what the /healthz watchdog and the
+        # span timeline must witness for a hang to be debuggable.
+        time.sleep(fault.seconds)
+        raise FaultTimeout(site, "hang",
+                           fault.message or f"simulated hang at {site} "
+                           f"exceeded its {fault.seconds}s watchdog")
+    return fault
+
+
+def _record(site: str, fault: FaultSpec, index: int, ctx: dict) -> None:
+    from ..telemetry import counter
+    from ..telemetry.events import emit_event
+
+    counter("faults_injected_total",
+            help="injected faults fired, by site and kind",
+            site=site, kind=fault.kind).inc()
+    emit_event({"event": "fault_injected", "site": site,
+                "kind": fault.kind, "call": index, **ctx})

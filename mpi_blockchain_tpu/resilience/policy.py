@@ -1,0 +1,121 @@
+"""Retry/timeout/backoff policy: capped exponential backoff with
+deterministic jitter, per-layer budgets, one loud give-up signal.
+
+Design constraints:
+
+* **Deterministic.** Jitter derives from (site, attempt, seed) through
+  crc32 — the same faulted run schedules the same sleeps, so replaying
+  a fault plan replays the recovery timeline too. No global RNG.
+* **Budgeted per layer.** Device dispatch, distributed init, and
+  checkpoint I/O fail differently (a wedged TPU init deserves more
+  patience than a torn local write); ``policy_for(site)`` carries the
+  per-layer table, and ``MPIBT_MAX_RETRIES`` caps attempts globally
+  for operators who want fail-fast CI.
+* **Selective.** ``ConfigError`` (and KeyboardInterrupt/SystemExit)
+  are never retried: a misconfiguration does not heal with backoff,
+  and retrying it would bury the clean CLI error contract.
+
+``call_with_retry`` is the ONE sanctioned swallow point for dispatch/IO
+exceptions — chainlint rule RES001 flags ad-hoc ``except Exception:
+pass`` swallowing anywhere else in those paths.
+"""
+from __future__ import annotations
+
+import dataclasses
+import struct
+import time
+import zlib
+
+from ..config import ConfigError
+from . import RetryExhausted
+
+#: Operator cap on attempts for every site (env; min 1 attempt).
+_ENV_MAX_ATTEMPTS = "MPIBT_MAX_RETRIES"
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Capped exponential backoff with deterministic, seeded jitter."""
+    max_attempts: int = 3        # total tries (first call included)
+    base_backoff_s: float = 0.01
+    max_backoff_s: float = 0.25
+    seed: int = 0
+
+    def backoff_s(self, site: str, attempt: int) -> float:
+        """Sleep before retry #attempt (0-based): capped exponential,
+        jittered into [cap/2, cap) by crc32(site, attempt, seed) — the
+        decorrelation real backoff needs, reproducible anyway."""
+        cap = min(self.base_backoff_s * (2 ** attempt), self.max_backoff_s)
+        key = site.encode() + struct.pack("<Ii", attempt, self.seed)
+        frac = zlib.crc32(key) % 1024 / 1024.0
+        return cap * (0.5 + 0.5 * frac)
+
+
+#: Per-layer budgets (docs/resilience.md). Dispatch failures are cheap
+#: to retry and cheap to degrade past; distributed init is expensive to
+#: abandon (the whole world restarts), so it gets the longest leash;
+#: checkpoint I/O retries cover transient FS errors only — integrity
+#: failures are CheckpointError (a ConfigError: never retried).
+_PER_SITE: dict[str, RetryPolicy] = {
+    "dispatch": RetryPolicy(max_attempts=3, base_backoff_s=0.01,
+                            max_backoff_s=0.25),
+    "distributed.init": RetryPolicy(max_attempts=4, base_backoff_s=0.25,
+                                    max_backoff_s=2.0),
+    "checkpoint.write": RetryPolicy(max_attempts=2, base_backoff_s=0.02,
+                                    max_backoff_s=0.1),
+    "checkpoint.read": RetryPolicy(max_attempts=2, base_backoff_s=0.02,
+                                   max_backoff_s=0.1),
+}
+_DEFAULT = RetryPolicy()
+
+
+def policy_for(site: str, seed: int = 0) -> RetryPolicy:
+    """The per-layer budget for a site; dotted sites fall back to their
+    layer prefix (``dispatch.tpu:jnp`` -> ``dispatch``)."""
+    from ..telemetry.events import env_number
+
+    base = _PER_SITE.get(site) or _PER_SITE.get(site.split(".", 1)[0],
+                                                _DEFAULT)
+    cap = env_number(_ENV_MAX_ATTEMPTS, None, cast=int, minimum=1)
+    attempts = base.max_attempts if cap is None else min(base.max_attempts,
+                                                         cap)
+    if attempts == base.max_attempts and seed == base.seed:
+        return base
+    return dataclasses.replace(base, max_attempts=attempts, seed=seed)
+
+
+NO_RETRY = (ConfigError, KeyboardInterrupt, SystemExit)
+
+
+def call_with_retry(fn, *, site: str, policy: RetryPolicy | None = None,
+                    sleep=time.sleep):
+    """Calls ``fn()`` under the site's retry budget.
+
+    Transient failures sleep the deterministic backoff and retry; the
+    final failure raises ``RetryExhausted`` (chaining the cause).
+    ``ConfigError`` propagates immediately — misconfiguration is not a
+    fault, and the CLI's clean-error contract depends on seeing it.
+    """
+    from ..telemetry import counter
+    from ..telemetry.events import emit_event
+
+    policy = policy if policy is not None else policy_for(site)
+    last: BaseException | None = None
+    for attempt in range(max(1, policy.max_attempts)):
+        try:
+            return fn()
+        except NO_RETRY:
+            raise
+        except Exception as e:
+            last = e
+            if attempt + 1 >= policy.max_attempts:
+                break
+            counter("retries_total",
+                    help="policy-layer retries after a transient failure",
+                    site=site).inc()
+            emit_event({"event": "retry", "site": site,
+                        "attempt": attempt + 1,
+                        "of": policy.max_attempts,
+                        "error": f"{type(e).__name__}: {e}"})
+            sleep(policy.backoff_s(site, attempt))
+    raise RetryExhausted(site, max(1, policy.max_attempts), last) from last

@@ -21,8 +21,15 @@ from typing import Callable
 from . import core
 from .backend import MinerBackend, backend_from_config
 from .config import ConfigError, MinerConfig, extend_payload
+from .resilience import injection
 from .telemetry import (CausalLog, counter, dump_causal_logs, gauge,
                         heartbeat, histogram)
+
+# Byzantine-sync length budget: the longest adopt suffix a node accepts
+# from a peer in one sync. An honest same-difficulty peer can never be
+# this far ahead inside one simulation (the bus delivers every step);
+# a response past it is a resource-exhaustion attack, not a fork heal.
+MAX_SYNC_SUFFIX = 4096
 
 # RecvResult codes as stable event vocabulary for the causal logs.
 _RESULT_NAMES = {
@@ -202,7 +209,14 @@ class SimNode:
         the peer's headers above the common ancestor, adopt the suffix.
         Falls back to a genesis-anchored (full-chain) fetch if the suffix
         unexpectedly fails to validate — the locator guarantees the anchor
-        is common, so the fallback is pure defense in depth."""
+        is common, so the fallback is pure defense in depth.
+
+        The peer's response is NOT trusted wholesale: before adoption it
+        must pass the byzantine bounds (``_validate_suffix`` — header
+        size, header-chain linkage from the anchor, and the
+        ``MAX_SYNC_SUFFIX`` length budget), or the sync is rejected with
+        a ``sync_rejected`` causal event and the chain stays untouched.
+        """
         own_height = self.node.height
         locator = [(h, self.node.block_hash(h))
                    for h in locator_heights(own_height)]
@@ -222,6 +236,10 @@ class SimNode:
                            step=self.sim_step, peer=peer.id, anchor=anchor,
                            fetched=len(suffix))
         self.stats.headers_fetched += len(suffix)
+        reason = self._validate_suffix(anchor, suffix)
+        if reason is not None:
+            self._reject_sync(peer, anchor, len(suffix), reason)
+            return
         res = self._adopt(anchor, suffix, own_height)
         if res == core.RecvResult.INVALID and anchor > 0:
             full = peer.node.all_headers()
@@ -234,7 +252,45 @@ class SimNode:
                                step=self.sim_step, peer=peer.id, anchor=0,
                                fetched=len(full))
             self.stats.headers_fetched += len(full)
+            reason = self._validate_suffix(0, full)
+            if reason is not None:
+                self._reject_sync(peer, 0, len(full), reason)
+                return
             self._adopt(0, full, own_height)
+
+    def _validate_suffix(self, anchor: int,
+                         suffix: list[bytes]) -> str | None:
+        """Byzantine bounds on a sync response; None when acceptable.
+
+        Linkage is checked Python-side before any C++ adoption work:
+        header i's prev_hash must equal the hash of header i-1 (the
+        anchor block for i == 0), every header must be exactly 80
+        bytes, and the whole response must fit the length budget. A
+        forged response therefore costs O(len) hashing to reject and
+        can never roll back a single block.
+        """
+        if len(suffix) > MAX_SYNC_SUFFIX:
+            return (f"suffix length {len(suffix)} exceeds the "
+                    f"{MAX_SYNC_SUFFIX}-header sync budget")
+        prev = self.node.block_hash(anchor)
+        for i, header in enumerate(suffix):
+            if len(header) != core.HEADER_SIZE:
+                return (f"header {i} is {len(header)} bytes, "
+                        f"not {core.HEADER_SIZE}")
+            fields = core.HeaderFields.unpack(header)
+            if fields.prev_hash != prev:
+                return f"header-chain linkage broken at offset {i}"
+            prev = core.header_hash(header)
+        return None
+
+    def _reject_sync(self, peer: "SimNode", anchor: int, count: int,
+                     reason: str) -> None:
+        self.causal.record("sync_rejected", step=self.sim_step,
+                           peer=peer.id, anchor=anchor, count=count,
+                           reason=reason)
+        counter("sim_sync_rejected_total",
+                help="peer sync responses rejected by the byzantine "
+                     "bounds before adoption").inc()
 
     def _adopt(self, anchor: int, suffix: list[bytes],
                own_height: int) -> int:
@@ -356,7 +412,31 @@ class Network:
                             sender=m.sender, receiver=node.id,
                             **_hdr_info(m.header80))
                     continue
-                node.receive(m.header80, sender_node, lamport=m.lamport)
+                # Fault-injection hook, per delivery attempt: raise/hang
+                # crash the sim step (the flight recorder's home turf);
+                # partial loses THIS delivery; corrupt damages the header
+                # in flight so consensus must reject it (both recorded on
+                # the bus's causal log for the forensics merge).
+                header80 = m.header80
+                fault = injection.check("sim.deliver", sender=m.sender,
+                                        receiver=node.id)
+                if fault is not None:
+                    self.causal.record(
+                        "fault", merge=m.lamport, step=self.step_count,
+                        site="sim.deliver", fault=fault.kind,
+                        sender=m.sender, receiver=node.id,
+                        **_hdr_info(m.header80))
+                    if fault.kind == "partial":
+                        counter("sim_messages_fault_lost_total",
+                                help="deliveries lost to an injected "
+                                     "partial fault").inc()
+                        continue
+                    # corrupt: flip a data_hash byte — same length, so
+                    # consensus sees a VALID-shaped but PoW-broken header.
+                    header80 = (header80[:40] +
+                                bytes([header80[40] ^ 0xFF]) +
+                                header80[41:])
+                node.receive(header80, sender_node, lamport=m.lamport)
                 counter("sim_messages_delivered_total",
                         help="announcements delivered to a peer").inc()
 

@@ -1,50 +1,315 @@
-"""Chain checkpoint/resume (SURVEY.md §5).
+"""Crash-safe chain checkpoint/resume (SURVEY.md §5, ISSUE 5).
 
-The reference has no persistence; the rebuild adds it so the 1000-block
-bench is restartable. A checkpoint is the chain's canonical wire format
-(concatenated 80-byte headers — the same bytes Chain::save emits and the
-adopt_chain RPC uses) plus a JSON sidecar with the config, so resume can
-refuse a difficulty mismatch instead of silently mining an invalid suffix.
-There is no device state to checkpoint: the search is stateless per block.
+A checkpoint is the chain's canonical wire format (concatenated 80-byte
+headers — the same bytes ``Chain::save`` emits and the adopt_chain RPC
+uses) plus an integrity trailer and a JSON sidecar with the config, so
+resume can refuse a difficulty mismatch instead of silently mining an
+invalid suffix. There is no device state to checkpoint: the search is
+stateless per block.
+
+Crash-safety contract (v2, this module's rewrite):
+
+* **Atomic writes.** Payload and sidecar are written tmp → flush →
+  fsync → ``os.replace`` (+ best-effort directory fsync), so a crash
+  mid-save leaves the PREVIOUS checkpoint intact, never a torn file at
+  the published path.
+* **Torn writes are detectable and loudly rejected.** The payload
+  carries a 48-byte trailer — ``MBTCKPT\\x01`` magic + u64 payload
+  length + SHA-256(payload). ``load_chain`` refuses on any mismatch
+  (CheckpointError), and a v2 sidecar without an intact trailer is
+  itself proof of a tear. The seed bug this kills: a truncated file
+  whose length happened to be a multiple of 80 used to load as a
+  silently SHORTER chain.
+* **Recovery truncates to the last valid block.** ``recover_chain``
+  (the ``mine --resume`` path) drops the torn tail, re-validates the
+  longest loadable header prefix, rewrites the repaired checkpoint
+  atomically, and reports what it dropped — so a SIGKILL'd miner
+  resumes instead of dying on its own artifact.
+* **Legacy files still load.** A pre-v2 checkpoint (no trailer, no v2
+  sidecar) validates through the C++ loader as before.
+
+Fault-injection sites ``checkpoint.write`` / ``checkpoint.read`` let a
+fault plan produce torn, bitrotted, or unreadable checkpoints
+deterministically (docs/resilience.md).
 """
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import json
+import os
 import pathlib
+import struct
 
 from .. import core
 from ..config import ConfigError, MinerConfig
+from ..resilience import injection
+
+MAGIC = b"MBTCKPT\x01"
+TRAILER_SIZE = len(MAGIC) + 8 + 32   # magic + u64 payload_len + sha256
+SIDECAR_VERSION = 2
+
+
+class CheckpointError(ConfigError):
+    """Integrity failure: torn write, bitrot, or an invalid chain. A
+    subclass of ConfigError so the CLI's clean-error contract and every
+    pre-existing ``except ValueError`` site keep holding; kept separate
+    so ``recover_chain`` can distinguish 'damaged artifact' (recover)
+    from 'wrong config' (refuse)."""
+
+
+def _sidecar_path(path: pathlib.Path) -> pathlib.Path:
+    return path.with_suffix(path.suffix + ".json")
+
+
+def _atomic_write(path: pathlib.Path, data: bytes,
+                  fsync: bool = True) -> None:
+    """tmp + flush + fsync + rename: the published path only ever holds
+    a complete artifact. The pid suffix keeps two processes saving to
+    the same path from clobbering each other's tmp."""
+    tmp = path.with_name(f"{path.name}.tmp.{os.getpid()}")
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        if fsync:
+            os.fsync(f.fileno())
+    os.replace(tmp, path)
+    if fsync:
+        try:
+            dfd = os.open(path.parent, os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
+        except OSError:
+            # Directory fsync is best-effort (not all filesystems allow
+            # it); the rename itself is already atomic.
+            return
+
+
+def seal(payload: bytes) -> bytes:
+    """Payload + the integrity trailer ``load_chain`` verifies."""
+    return payload + MAGIC + struct.pack("<Q", len(payload)) + \
+        hashlib.sha256(payload).digest()
+
+
+def split_trailer(blob: bytes) -> tuple[bytes, bool]:
+    """Splits a checkpoint blob into (payload, verified).
+
+    ``verified`` is True when an intact trailer authenticated the
+    payload; False when no trailer is present (a legacy file — or a
+    tear, which the sidecar disambiguates). A PRESENT-but-inconsistent
+    trailer raises: that can only be corruption.
+    """
+    if len(blob) >= TRAILER_SIZE and \
+            blob[-TRAILER_SIZE:-40] == MAGIC:
+        payload = blob[:-TRAILER_SIZE]
+        length, = struct.unpack("<Q", blob[-40:-32])
+        digest = blob[-32:]
+        if length != len(payload) or \
+                hashlib.sha256(payload).digest() != digest:
+            raise CheckpointError(
+                "checkpoint trailer mismatch (torn write or bitrot): "
+                f"trailer claims {length} payload bytes, "
+                f"file holds {len(payload)}")
+        return payload, True
+    return blob, False
 
 
 def save_chain(node: core.Node, path: str | pathlib.Path,
-               config: MinerConfig | None = None) -> None:
+               config: MinerConfig | dict | None = None,
+               fsync: bool = True) -> pathlib.Path:
+    """Atomically writes the chain checkpoint + sidecar; returns path.
+    ``config`` may be a MinerConfig or an already-serialized config dict
+    (the recovery rewrite preserves the original sidecar's)."""
+    from ..resilience import FaultInjected
+    from ..telemetry import counter
+    from ..telemetry.events import emit_event
+
     path = pathlib.Path(path)
-    path.write_bytes(node.save())
-    meta = {"height": node.height, "tip_hash": node.tip_hash.hex(),
-            "difficulty_bits": node.difficulty_bits}
+    payload = node.save()
+    blob = seal(payload)
+    fault = injection.check("checkpoint.write", path=str(path),
+                            height=node.height)
+    if fault is not None and fault.kind == "partial":
+        # The injected torn write: bypass the atomic path and publish a
+        # truncated artifact directly — exactly the on-disk state a
+        # crash mid-write used to leave — then die like the crash would.
+        with open(path, "wb") as f:
+            f.write(blob[:max(1, len(blob) // 2)])
+        raise FaultInjected("checkpoint.write", "partial",
+                            fault.message or f"torn checkpoint write "
+                            f"at {path}")
+    meta = {"checkpoint_version": SIDECAR_VERSION,
+            "height": node.height, "tip_hash": node.tip_hash.hex(),
+            "difficulty_bits": node.difficulty_bits,
+            "payload_len": len(payload),
+            "payload_sha256": hashlib.sha256(payload).hexdigest()}
     if config is not None:
-        meta["config"] = dataclasses.asdict(config)
-    path.with_suffix(path.suffix + ".json").write_text(
-        json.dumps(meta, sort_keys=True))
+        meta["config"] = (config if isinstance(config, dict)
+                          else dataclasses.asdict(config))
+    _atomic_write(path, blob, fsync=fsync)
+    _atomic_write(_sidecar_path(path),
+                  json.dumps(meta, sort_keys=True).encode(), fsync=fsync)
+    if fault is not None and fault.kind == "corrupt":
+        # Injected bitrot: flip one payload byte of the PUBLISHED file
+        # (after a clean write — rot happens at rest, not in flight).
+        rotted = bytearray(path.read_bytes())
+        rotted[len(rotted) // 2] ^= 0xFF
+        path.write_bytes(bytes(rotted))
+    counter("checkpoints_saved_total",
+            help="chain checkpoints written (atomic, sealed)").inc()
+    emit_event({"event": "checkpoint_saved", "height": node.height,
+                "path": str(path)})
+    return path
+
+
+def _sidecar_version(meta: dict) -> int:
+    """The sidecar's checkpoint_version as an int; a non-numeric value
+    is sidecar corruption (loud CheckpointError, so recover_chain can
+    still salvage an intact payload), never a TypeError."""
+    v = meta.get("checkpoint_version", 1)
+    try:
+        return int(v)
+    except (TypeError, ValueError):
+        raise CheckpointError(
+            f"corrupt checkpoint sidecar: non-numeric "
+            f"checkpoint_version {v!r}") from None
+
+
+def _read_sidecar(path: pathlib.Path) -> dict | None:
+    sidecar = _sidecar_path(path)
+    if not sidecar.exists():
+        return None
+    try:
+        meta = json.loads(sidecar.read_text())
+    except json.JSONDecodeError as e:
+        raise CheckpointError(
+            f"corrupt checkpoint sidecar {sidecar}: {e}") from e
+    if not isinstance(meta, dict):
+        raise CheckpointError(f"corrupt checkpoint sidecar {sidecar}: "
+                              f"not a JSON object")
+    return meta
+
+
+def open_checkpoint(path: str | pathlib.Path,
+                    blob: bytes | None = None
+                    ) -> tuple[bytes, bool, dict | None]:
+    """Integrity-checks a checkpoint blob against its trailer AND its
+    sidecar; returns (payload, sealed, sidecar_meta). The shared gate
+    for ``load_chain`` and ``verify --chain``: a v2 sidecar whose
+    trailer is gone (the tear that lands exactly on the trailer
+    boundary) or whose digest disagrees raises here — neither reader
+    may bless a torn artifact as a valid shorter chain. Legacy files
+    (no trailer, no v2 sidecar) pass through unsealed."""
+    path = pathlib.Path(path)
+    if blob is None:
+        blob = path.read_bytes()
+    meta = _read_sidecar(path)
+    payload, verified = split_trailer(blob)
+    sealed_meta = meta is not None and (
+        _sidecar_version(meta) >= 2 or "payload_sha256" in meta)
+    if sealed_meta and not verified:
+        raise CheckpointError(
+            f"torn checkpoint write detected: sidecar declares a sealed "
+            f"v{_sidecar_version(meta)} checkpoint but {path} "
+            f"has no intact trailer")
+    if sealed_meta and meta.get("payload_sha256") != \
+            hashlib.sha256(payload).hexdigest():
+        raise CheckpointError(
+            f"checkpoint payload digest does not match its sidecar: "
+            f"{path} (torn write or bitrot)")
+    if not payload or len(payload) % core.HEADER_SIZE:
+        raise CheckpointError(
+            f"torn or empty chain checkpoint: {path} holds "
+            f"{len(payload)} bytes, not a whole number of "
+            f"{core.HEADER_SIZE}-byte headers")
+    return payload, verified, meta
 
 
 def load_chain(path: str | pathlib.Path, difficulty_bits: int,
                node_id: int = 0) -> core.Node:
-    """Restores a Node from a checkpoint, re-validating every block."""
+    """Restores a Node from a checkpoint, verifying integrity end to
+    end: trailer (or sidecar-declared trailer absence = tear), sidecar
+    digest, difficulty, then full C++ re-validation of every block."""
     path = pathlib.Path(path)
-    sidecar = path.with_suffix(path.suffix + ".json")
-    if sidecar.exists():
-        try:
-            meta = json.loads(sidecar.read_text())
-        except json.JSONDecodeError as e:
-            raise ConfigError(
-                f"corrupt checkpoint sidecar {sidecar}: {e}") from e
-        if meta.get("difficulty_bits") != difficulty_bits:
-            raise ConfigError(
-                f"checkpoint difficulty {meta.get('difficulty_bits')} != "
-                f"requested {difficulty_bits}")
+    fault = injection.check("checkpoint.read", path=str(path))
+    blob = path.read_bytes()
+    if fault is not None:
+        if fault.kind == "corrupt":
+            rotted = bytearray(blob)
+            rotted[len(rotted) // 2] ^= 0xFF
+            blob = bytes(rotted)
+        elif fault.kind == "partial":
+            blob = blob[:max(1, len(blob) // 2)]
+    meta = _read_sidecar(path)
+    if meta is not None and meta.get("difficulty_bits") != difficulty_bits:
+        raise ConfigError(
+            f"checkpoint difficulty {meta.get('difficulty_bits')} != "
+            f"requested {difficulty_bits}")
+    payload, _, _ = open_checkpoint(path, blob)
     node = core.Node(difficulty_bits, node_id)
-    if not node.load(path.read_bytes()):
-        raise ConfigError(f"invalid or corrupt chain checkpoint: {path}")
+    if not node.load(payload):
+        raise CheckpointError(f"invalid or corrupt chain checkpoint: "
+                              f"{path}")
     return node
+
+
+def recover_chain(path: str | pathlib.Path, difficulty_bits: int,
+                  node_id: int = 0) -> tuple[core.Node, dict]:
+    """``mine --resume``'s loader: load, or truncate a torn tail to the
+    last valid block and load THAT.
+
+    Only integrity damage (CheckpointError) triggers recovery; a
+    difficulty mismatch or unreadable file still refuses — recovering
+    from a *wrong* checkpoint would be the silent-corruption bug this
+    module exists to kill. On recovery the repaired checkpoint is
+    rewritten atomically so the next resume is clean, and the report
+    says exactly what was dropped.
+    """
+    from ..telemetry import counter
+    from ..telemetry.events import emit_event
+
+    path = pathlib.Path(path)
+    try:
+        node = load_chain(path, difficulty_bits, node_id)
+        return node, {"recovered": False, "height": node.height,
+                      "dropped_bytes": 0}
+    except CheckpointError as damage:
+        blob = path.read_bytes()
+        try:
+            payload, _ = split_trailer(blob)
+        except CheckpointError:
+            # A PRESENT-but-inconsistent trailer is still metadata, not
+            # chain bytes: strip it so dropped_bytes counts only chain
+            # data (a digest-only bitrot must report 0 bytes lost).
+            payload = blob
+            if len(blob) >= TRAILER_SIZE and \
+                    blob[-TRAILER_SIZE:-40] == MAGIC:
+                payload = blob[:-TRAILER_SIZE]
+        try:
+            config = (_read_sidecar(path) or {}).get("config")
+        except CheckpointError:
+            config = None    # sidecar itself corrupt: nothing to keep
+        usable = payload[:len(payload) - len(payload) % core.HEADER_SIZE]
+        for k in range(len(usable) // core.HEADER_SIZE, 0, -1):
+            node = core.Node(difficulty_bits, node_id)
+            if node.load(usable[:k * core.HEADER_SIZE]):
+                # Chain bytes actually lost — measured against the
+                # PAYLOAD, not the raw blob (the 48-byte trailer is
+                # metadata; counting it would report a spurious tear
+                # when only the seal was damaged).
+                dropped = len(payload) - k * core.HEADER_SIZE
+                counter("checkpoint_recoveries_total",
+                        help="torn checkpoints truncated to their last "
+                             "valid block on resume").inc()
+                emit_event({"event": "checkpoint_truncated",
+                            "path": str(path), "height": node.height,
+                            "dropped_bytes": dropped,
+                            "damage": str(damage)})
+                # Rewrite the repaired artifact, preserving the original
+                # sidecar's recorded run config when it survived.
+                save_chain(node, path, config)
+                return node, {"recovered": True, "height": node.height,
+                              "dropped_bytes": dropped}
+        raise

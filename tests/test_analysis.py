@@ -358,3 +358,95 @@ def test_tel001_cli_pass_family(tmp_path):
         cwd=ROOT, capture_output=True, text=True, timeout=600)
     assert proc.returncode == 1, proc.stdout + proc.stderr
     assert "TEL001" in proc.stdout
+
+
+# ---- RES001: swallow-proof fault handling in dispatch/IO paths ---------
+
+
+BAD_SWALLOWS = textwrap.dedent("""\
+    def dispatch(backend, header):
+        try:
+            return backend.search(header)
+        except Exception:
+            pass                       # RES001: silent swallow
+        for attempt in range(3):
+            try:
+                return backend.search(header)
+            except BaseException:
+                continue               # RES001: silent swallow
+        try:
+            return backend.search(header)
+        except:
+            return None                # RES001: bare except, no re-raise
+    """)
+
+OK_HANDLERS = textwrap.dedent("""\
+    def dispatch(backend, header, log):
+        try:
+            return backend.search(header)
+        except OSError:
+            pass                       # specific: allowed
+        try:
+            return backend.search(header)
+        except Exception as e:
+            log(e)                     # broad but recorded: allowed
+            return None
+        try:
+            return backend.search(header)
+        except:
+            raise                      # bare but re-raises: allowed
+    """)
+
+
+def test_res001_swallows_fire(tmp_path):
+    from mpi_blockchain_tpu.analysis.resilience_lint import (
+        run_resilience_lint)
+
+    bad = tmp_path / "bad_dispatch.py"
+    bad.write_text(BAD_SWALLOWS)
+    findings = run_resilience_lint(ROOT,
+                                   overrides={"resilience_files": [bad]})
+    assert rule_set(findings) == {"RES001"}
+    assert len(findings) == 3
+
+
+def test_res001_sanctioned_patterns_pass(tmp_path):
+    from mpi_blockchain_tpu.analysis.resilience_lint import (
+        run_resilience_lint)
+
+    ok = tmp_path / "ok_dispatch.py"
+    ok.write_text(OK_HANDLERS)
+    assert run_resilience_lint(
+        ROOT, overrides={"resilience_files": [ok]}) == []
+
+
+def test_res001_inline_suppression(tmp_path):
+    suppressed = BAD_SWALLOWS.replace(
+        "    except Exception:",
+        "    except Exception:  # chainlint: disable=RES001")
+    bad = tmp_path / "bad_dispatch.py"
+    bad.write_text(suppressed)
+    findings = run_all(root=tmp_path, passes=["resilience"],
+                       overrides={"resilience_files": [bad]})
+    assert len([f for f in findings if f.rule == "RES001"]) == 2
+
+
+def test_res001_live_tree_clean():
+    """The dispatch/IO paths obey their own swallow discipline."""
+    from mpi_blockchain_tpu.analysis.resilience_lint import (
+        run_resilience_lint)
+
+    findings = run_resilience_lint(ROOT)
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_res001_cli_pass_family(tmp_path):
+    bad = tmp_path / "bad_dispatch.py"
+    bad.write_text(BAD_SWALLOWS)
+    proc = subprocess.run(
+        [sys.executable, "-m", "mpi_blockchain_tpu.analysis",
+         "--passes", "resilience", "--override",
+         f"resilience_files={bad}"],
+        cwd=ROOT, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "RES001" in proc.stdout

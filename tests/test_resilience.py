@@ -1,0 +1,778 @@
+"""Resilience subsystem tests (ISSUE 5): deterministic fault plans,
+retry/backoff policy, the degradation ladder, crash-safe checkpoints,
+kill+resume recovery, byzantine sync bounds, and the CLI exit codes."""
+import json
+import os
+import pathlib
+import signal
+import subprocess
+import sys
+
+import pytest
+
+from mpi_blockchain_tpu import core
+from mpi_blockchain_tpu.config import ConfigError, MinerConfig
+from mpi_blockchain_tpu.models.miner import Miner
+from mpi_blockchain_tpu.resilience import (FaultInjected, FaultPlanError,
+                                           RetryExhausted, injection)
+from mpi_blockchain_tpu.resilience.faultplan import SITES, FaultPlan
+from mpi_blockchain_tpu.resilience.policy import (RetryPolicy,
+                                                  call_with_retry)
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(autouse=True)
+def _disarmed():
+    """Every test starts and ends with no plan armed (process-global)."""
+    injection.disarm()
+    yield
+    injection.disarm()
+
+
+def _plan(*faults, **kw):
+    return FaultPlan.from_dict({"version": 1, "faults": list(faults), **kw})
+
+
+# ---- fault plans -------------------------------------------------------
+
+
+def test_faultplan_from_seed_deterministic():
+    a = FaultPlan.from_seed(7)
+    b = FaultPlan.from_seed(7)
+    assert a == b and a.to_dict() == b.to_dict()
+    assert a != FaultPlan.from_seed(8)
+    for f in a.faults:
+        assert f.site in SITES and f.kind in ("raise", "hang", "corrupt",
+                                              "partial")
+
+
+def test_faultplan_json_roundtrip(tmp_path):
+    plan = _plan({"site": "sim.deliver", "kind": "corrupt", "call": 2,
+                  "times": 3}, seed=9, strict=True)
+    p = tmp_path / "plan.json"
+    p.write_text(json.dumps(plan.to_dict()))
+    assert FaultPlan.load(p) == plan
+
+
+@pytest.mark.parametrize("bad", [
+    {"faults": [{"site": "nope", "kind": "raise"}]},
+    {"faults": [{"site": "sim.deliver", "kind": "explode"}]},
+    {"faults": [{"site": "sim.deliver", "kind": "raise", "call": -1}]},
+    {"faults": [{"site": "sim.deliver", "kind": "raise", "times": 0}]},
+    {"faults": [{"site": "sim.deliver", "kind": "raise", "bogus": 1}]},
+    {"version": 99},
+    {"faults": "not-a-list"},
+])
+def test_faultplan_invalid_specs_raise(bad):
+    with pytest.raises(FaultPlanError):
+        FaultPlan.from_dict(bad)
+
+
+def test_faultplan_parse_arg(tmp_path):
+    assert FaultPlan.parse_arg("seed:4") == FaultPlan.from_seed(4)
+    with pytest.raises(FaultPlanError):
+        FaultPlan.parse_arg("seed:xyz")
+    with pytest.raises(FaultPlanError):
+        FaultPlan.parse_arg(str(tmp_path / "missing.json"))
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    with pytest.raises(FaultPlanError):
+        FaultPlan.parse_arg(str(bad))
+
+
+def test_injection_fires_at_call_index():
+    injection.arm(_plan({"site": "backend.cpu.search", "kind": "raise",
+                         "call": 2, "times": 2}))
+    assert injection.check("backend.cpu.search") is None   # call 0
+    assert injection.check("backend.cpu.search") is None   # call 1
+    for _ in range(2):                                     # calls 2, 3
+        with pytest.raises(FaultInjected):
+            injection.check("backend.cpu.search")
+    assert injection.check("backend.cpu.search") is None   # call 4
+    # Other sites keep independent counters.
+    assert injection.check("sim.deliver") is None
+    assert injection.call_counts() == {"backend.cpu.search": 5,
+                                       "sim.deliver": 1}
+
+
+def test_injection_strict_unfired_raises():
+    injection.arm(_plan({"site": "sim.deliver", "kind": "raise",
+                         "call": 100}, strict=True))
+    with pytest.raises(FaultPlanError, match="not exhausted"):
+        injection.disarm(strict=True)
+    # Non-strict disarm (the CLI's error path) never raises.
+    injection.arm(_plan({"site": "sim.deliver", "kind": "raise",
+                         "call": 100}, strict=True))
+    injection.disarm()
+
+
+def test_injection_corrupt_returned_to_hook():
+    injection.arm(_plan({"site": "checkpoint.write", "kind": "corrupt"}))
+    fault = injection.check("checkpoint.write")
+    assert fault is not None and fault.kind == "corrupt"
+
+
+# ---- retry policy ------------------------------------------------------
+
+
+def test_backoff_deterministic_and_capped():
+    p = RetryPolicy(max_attempts=5, base_backoff_s=0.01,
+                    max_backoff_s=0.05, seed=3)
+    seq = [p.backoff_s("dispatch.cpu", a) for a in range(5)]
+    assert seq == [p.backoff_s("dispatch.cpu", a) for a in range(5)]
+    assert all(0 < s < 0.05 for s in seq)
+    assert p.backoff_s("dispatch.cpu", 0) != \
+        RetryPolicy(max_attempts=5, base_backoff_s=0.01,
+                    max_backoff_s=0.05, seed=4).backoff_s("dispatch.cpu", 0)
+
+
+def test_call_with_retry_recovers_and_exhausts():
+    sleeps = []
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("transient")
+        return "ok"
+
+    assert call_with_retry(flaky, site="dispatch.test",
+                           policy=RetryPolicy(max_attempts=3),
+                           sleep=sleeps.append) == "ok"
+    assert calls["n"] == 3 and len(sleeps) == 2
+
+    def dead():
+        raise OSError("permanent")
+
+    with pytest.raises(RetryExhausted) as ei:
+        call_with_retry(dead, site="dispatch.test",
+                        policy=RetryPolicy(max_attempts=2),
+                        sleep=sleeps.append)
+    assert ei.value.attempts == 2
+    assert isinstance(ei.value.last, OSError)
+
+
+def test_call_with_retry_never_retries_config_errors():
+    calls = {"n": 0}
+
+    def misconfigured():
+        calls["n"] += 1
+        raise ConfigError("bad kernel")
+
+    with pytest.raises(ConfigError, match="bad kernel"):
+        call_with_retry(misconfigured, site="dispatch.test",
+                        policy=RetryPolicy(max_attempts=5),
+                        sleep=lambda s: None)
+    assert calls["n"] == 1
+
+
+# ---- degradation ladder ------------------------------------------------
+
+
+def _fast_policy():
+    return RetryPolicy(max_attempts=2, base_backoff_s=0.0,
+                       max_backoff_s=0.0)
+
+
+def test_ladder_degrades_to_cpu_and_chain_matches_oracle():
+    from mpi_blockchain_tpu.resilience.dispatch import (ResilientBackend,
+                                                        ladder_from_config)
+
+    cfg = MinerConfig(difficulty_bits=8, n_blocks=2, backend="tpu",
+                      kernel="jnp", batch_pow2=11)
+    injection.arm(_plan({"site": "backend.tpu.dispatch", "kind": "raise",
+                         "times": -1}))
+    backend = ResilientBackend(ladder_from_config(cfg),
+                               policy=_fast_policy())
+    miner = Miner(cfg, backend=backend)
+    miner.mine_chain()
+    assert backend.degraded and backend.rung == "cpu"
+    assert backend.name == "cpu"
+    assert [d["to"] for d in backend.degradations] == ["cpu"]
+    injection.disarm()
+    oracle = Miner(MinerConfig(difficulty_bits=8, n_blocks=2,
+                               backend="cpu"))
+    oracle.mine_chain()
+    assert miner.chain_hashes() == oracle.chain_hashes()
+
+
+def test_ladder_validates_corrupt_results():
+    from mpi_blockchain_tpu.backend import (MinerBackend, SearchResult,
+                                            get_backend)
+    from mpi_blockchain_tpu.resilience.dispatch import ResilientBackend
+
+    cfg = MinerConfig(difficulty_bits=8, n_blocks=1, backend="cpu")
+
+    class LyingBackend(MinerBackend):
+        name = "liar"
+
+        def search(self, header80, difficulty_bits, start_nonce=0,
+                   max_count=1 << 32):
+            return SearchResult(start_nonce, b"\x00" * 32, 1)
+
+    ladder = [("liar", LyingBackend),
+              ("cpu", lambda: get_backend("cpu", n_ranks=1))]
+    backend = ResilientBackend(ladder, policy=_fast_policy())
+    miner = Miner(cfg, backend=backend)
+    rec = miner.mine_block()
+    # The fabricated winner was rejected by host-side re-validation and
+    # the ladder stepped down to the honest rung.
+    assert backend.degraded and backend.rung == "cpu"
+    assert core.leading_zero_bits(bytes.fromhex(rec.hash)) >= 8
+
+
+def test_ladder_exhausted_raises_retry_exhausted():
+    from mpi_blockchain_tpu.resilience.dispatch import (ResilientBackend,
+                                                        ladder_from_config)
+
+    cfg = MinerConfig(difficulty_bits=8, n_blocks=1, backend="cpu")
+    injection.arm(_plan({"site": "backend.cpu.search", "kind": "raise",
+                         "times": -1}))
+    backend = ResilientBackend(ladder_from_config(cfg),
+                               policy=_fast_policy())
+    with pytest.raises(RetryExhausted):
+        backend.search(core.Node(8, 0).make_candidate(b"x"), 8)
+
+
+def test_ladder_config_error_propagates_without_degrading():
+    from mpi_blockchain_tpu.backend import MinerBackend
+    from mpi_blockchain_tpu.resilience.dispatch import ResilientBackend
+
+    class Misconfigured(MinerBackend):
+        name = "boom"
+
+        def search(self, *a, **k):
+            raise ConfigError("explicit kernel unavailable")
+
+    backend = ResilientBackend(
+        [("boom", Misconfigured), ("boom2", Misconfigured)],
+        policy=_fast_policy())
+    with pytest.raises(ConfigError, match="explicit kernel"):
+        backend.search(b"\x00" * 80, 8)
+    assert not backend.degraded
+
+
+def test_backend_from_config_wraps_by_default():
+    from mpi_blockchain_tpu.backend import backend_from_config
+    from mpi_blockchain_tpu.backend.cpu import CpuBackend
+    from mpi_blockchain_tpu.resilience.dispatch import ResilientBackend
+
+    cfg = MinerConfig(difficulty_bits=8, backend="cpu")
+    wrapped = backend_from_config(cfg)
+    assert isinstance(wrapped, ResilientBackend)
+    assert isinstance(wrapped.active_backend, CpuBackend)
+    assert wrapped.name == "cpu" and not wrapped.degraded
+    raw = backend_from_config(cfg, resilient=False)
+    assert isinstance(raw, CpuBackend)
+
+
+# ---- crash-safe checkpoints --------------------------------------------
+
+
+def _mined(n=3, difficulty=8):
+    miner = Miner(MinerConfig(difficulty_bits=difficulty, n_blocks=n,
+                              backend="cpu"))
+    miner.mine_chain()
+    return miner
+
+
+def test_checkpoint_sealed_roundtrip_no_tmp_left(tmp_path):
+    from mpi_blockchain_tpu.utils.checkpoint import (load_chain,
+                                                     save_chain)
+
+    miner = _mined()
+    path = tmp_path / "chain.bin"
+    save_chain(miner.node, path, miner.config)
+    assert not list(tmp_path.glob("*.tmp.*")), "tmp artifact left behind"
+    node = load_chain(path, 8)
+    assert node.height == 3 and node.tip_hash == miner.node.tip_hash
+    meta = json.loads((tmp_path / "chain.bin.json").read_text())
+    assert meta["checkpoint_version"] == 2
+    assert meta["payload_len"] == (3 + 1) * core.HEADER_SIZE
+
+
+def test_checkpoint_torn_tail_loudly_rejected(tmp_path):
+    from mpi_blockchain_tpu.utils.checkpoint import (CheckpointError,
+                                                     load_chain,
+                                                     save_chain)
+
+    miner = _mined()
+    path = tmp_path / "chain.bin"
+    save_chain(miner.node, path, miner.config)
+    blob = path.read_bytes()
+    # The seed bug: a tear that lands on an 80-byte boundary used to
+    # load as a silently SHORTER chain. It must now be loudly rejected.
+    path.write_bytes(blob[:2 * core.HEADER_SIZE])
+    with pytest.raises(CheckpointError, match="torn"):
+        load_chain(path, 8)
+    # A mid-header tear is rejected too.
+    path.write_bytes(blob[:len(blob) - 100])
+    with pytest.raises(CheckpointError):
+        load_chain(path, 8)
+
+
+def test_checkpoint_bitrot_detected(tmp_path):
+    from mpi_blockchain_tpu.utils.checkpoint import (CheckpointError,
+                                                     load_chain,
+                                                     save_chain)
+
+    miner = _mined()
+    path = tmp_path / "chain.bin"
+    save_chain(miner.node, path, miner.config)
+    rotted = bytearray(path.read_bytes())
+    rotted[100] ^= 0x01
+    path.write_bytes(bytes(rotted))
+    with pytest.raises(CheckpointError):
+        load_chain(path, 8)
+
+
+def test_checkpoint_legacy_file_still_loads(tmp_path):
+    from mpi_blockchain_tpu.utils.checkpoint import load_chain
+
+    miner = _mined()
+    path = tmp_path / "legacy.bin"
+    path.write_bytes(miner.node.save())   # raw headers, no trailer/sidecar
+    node = load_chain(path, 8)
+    assert node.height == 3 and node.tip_hash == miner.node.tip_hash
+
+
+def test_recover_chain_truncates_to_last_valid_block(tmp_path):
+    from mpi_blockchain_tpu.utils.checkpoint import (load_chain,
+                                                     recover_chain,
+                                                     save_chain)
+
+    miner = _mined(4)
+    path = tmp_path / "chain.bin"
+    save_chain(miner.node, path, miner.config)
+    blob = path.read_bytes()
+    path.write_bytes(blob[:len(blob) - 120])   # trailer + most of a header
+    node, report = recover_chain(path, 8)
+    assert report["recovered"] is True and node.height == 3
+    assert report["dropped_bytes"] > 0
+    # The repaired checkpoint was rewritten sealed: a plain load works.
+    assert load_chain(path, 8).height == 3
+    # Resume mining on the recovered chain extends it validly.
+    m2 = Miner(MinerConfig(difficulty_bits=8, n_blocks=1, backend="cpu"))
+    m2.node = node
+    m2.mine_block()
+    assert m2.node.height == 4
+
+
+def test_recover_chain_refuses_difficulty_mismatch(tmp_path):
+    from mpi_blockchain_tpu.utils.checkpoint import (recover_chain,
+                                                     save_chain)
+
+    miner = _mined()
+    path = tmp_path / "chain.bin"
+    save_chain(miner.node, path, miner.config)
+    with pytest.raises(ConfigError, match="difficulty"):
+        recover_chain(path, 16)
+
+
+def test_checkpoint_write_fault_leaves_detectable_torn_file(tmp_path):
+    from mpi_blockchain_tpu.utils.checkpoint import (CheckpointError,
+                                                     load_chain,
+                                                     recover_chain,
+                                                     save_chain)
+
+    miner = _mined()
+    path = tmp_path / "chain.bin"
+    save_chain(miner.node, path, miner.config)   # a prior good save
+    injection.arm(_plan({"site": "checkpoint.write", "kind": "partial"}))
+    with pytest.raises(FaultInjected):
+        save_chain(miner.node, path, miner.config)
+    injection.disarm()
+    with pytest.raises(CheckpointError):
+        load_chain(path, 8)
+    node, report = recover_chain(path, 8)
+    assert report["recovered"] is True and node.height >= 0
+
+
+# ---- byzantine sync bounds ---------------------------------------------
+
+
+def _sim_pair():
+    from mpi_blockchain_tpu.simulation import Network, SimNode
+
+    cfg = MinerConfig(difficulty_bits=8, n_blocks=4, backend="cpu")
+    nodes = [SimNode(0, cfg), SimNode(1, cfg)]
+    net = Network(nodes)
+    return net, nodes
+
+
+def _evil_peer(headers):
+    """A byzantine peer duck-typed to _sync_from's surface: it claims a
+    common anchor at genesis and serves whatever headers it likes."""
+    import types
+
+    from mpi_blockchain_tpu.telemetry import CausalLog
+
+    return types.SimpleNamespace(
+        id=99, sim_step=0, causal=CausalLog(99),
+        find_anchor=lambda locator: 0,
+        node=types.SimpleNamespace(
+            headers_from=lambda h: list(headers),
+            all_headers=lambda: list(headers)))
+
+
+def test_sync_rejects_unlinked_suffix():
+    net, (a, b) = _sim_pair()
+    garbage = [os.urandom(core.HEADER_SIZE) for _ in range(3)]
+    tip_before = a.node.tip_hash
+    a._sync_from(_evil_peer(garbage))
+    assert a.node.tip_hash == tip_before, "garbage suffix was adopted"
+    rejected = [e for e in a.causal.events()
+                if e["kind"] == "sync_rejected"]
+    assert rejected and "linkage" in rejected[-1]["reason"]
+
+
+def test_sync_rejects_wrong_sized_header():
+    net, (a, b) = _sim_pair()
+    tip_before = a.node.tip_hash
+    a._sync_from(_evil_peer([b"\x00" * 10]))
+    assert a.node.tip_hash == tip_before
+    rejected = [e for e in a.causal.events()
+                if e["kind"] == "sync_rejected"]
+    assert rejected and "bytes" in rejected[-1]["reason"]
+
+
+def test_sync_rejects_oversized_suffix(monkeypatch):
+    import mpi_blockchain_tpu.simulation as sim
+
+    net, (a, b) = _sim_pair()
+    monkeypatch.setattr(sim, "MAX_SYNC_SUFFIX", 2)
+    garbage = [os.urandom(core.HEADER_SIZE) for _ in range(3)]
+    tip_before = a.node.tip_hash
+    a._sync_from(_evil_peer(garbage))
+    assert a.node.tip_hash == tip_before
+    rejected = [e for e in a.causal.events()
+                if e["kind"] == "sync_rejected"]
+    assert rejected and "budget" in rejected[-1]["reason"]
+
+
+def test_honest_sync_still_adopts():
+    net, (a, b) = _sim_pair()
+    mined = 0
+    for _ in range(500):
+        if b.mine_step(1 << 8) is not None:
+            mined += 1
+            if mined >= 2:
+                break
+    assert b.node.height >= 2
+    a._sync_from(b)
+    assert a.node.tip_hash == b.node.tip_hash
+    assert not [e for e in a.causal.events()
+                if e["kind"] == "sync_rejected"]
+
+
+# ---- fault-plan fuzz ----------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_faultplan_fuzz_converges_or_fails_clean(seed):
+    """Seeded plans through a short sim: every outcome must be either
+    convergence or a CLEAN, typed failure — no hangs (bounded steps,
+    bounded retries, short injected wedges), no silent corruption (the
+    stats conservation invariant holds on every surviving node)."""
+    from mpi_blockchain_tpu.simulation import run_adversarial
+
+    plan = FaultPlan.from_seed(seed, n_faults=2,
+                               sites=("backend.cpu.search", "sim.deliver"))
+    injection.arm(plan)
+    cfg = MinerConfig(difficulty_bits=8, n_blocks=3, backend="cpu")
+    try:
+        net = run_adversarial(config=cfg, partition_steps=6,
+                              target_height=3, nonce_budget=1 << 8)
+    except (FaultInjected, RetryExhausted, RuntimeError):
+        return   # clean, typed failure — an acceptable outcome
+    finally:
+        injection.disarm()
+    assert net.converged()
+    for n in net.nodes:
+        assert n.stats.conserved_height() == n.node.height
+
+
+# ---- CLI exit codes + recovery flow ------------------------------------
+
+
+def test_cli_fault_plan_invalid_rc3(tmp_path, capsys):
+    from mpi_blockchain_tpu.cli import main
+
+    rc = main(["mine", "--difficulty", "8", "--blocks", "1", "--backend",
+               "cpu", "--fault-plan", str(tmp_path / "missing.json")])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 3 and out["kind"] == "fault_plan"
+
+
+def test_cli_strict_plan_unexhausted_rc3(tmp_path, capsys):
+    from mpi_blockchain_tpu.cli import main
+
+    plan = tmp_path / "plan.json"
+    plan.write_text(json.dumps({"version": 1, "strict": True, "faults": [
+        {"site": "sim.deliver", "kind": "raise", "call": 10 ** 6}]}))
+    rc = main(["mine", "--difficulty", "8", "--blocks", "1", "--backend",
+               "cpu", "--fault-plan", str(plan)])
+    out = json.loads(capsys.readouterr().out.splitlines()[-1])
+    assert rc == 3 and "not exhausted" in out["error"]
+
+
+def test_cli_retries_exhausted_rc2(tmp_path, capsys):
+    from mpi_blockchain_tpu.cli import main
+
+    plan = tmp_path / "plan.json"
+    plan.write_text(json.dumps({"version": 1, "faults": [
+        {"site": "backend.cpu.search", "kind": "raise", "times": -1}]}))
+    rc = main(["mine", "--difficulty", "8", "--blocks", "1", "--backend",
+               "cpu", "--fault-plan", str(plan)])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 2 and out["kind"] == "retry_exhausted"
+    assert out["site"].startswith("dispatch.")
+
+
+def test_cli_degraded_run_converges_rc0(tmp_path, capsys):
+    from mpi_blockchain_tpu.cli import main
+
+    plan = tmp_path / "plan.json"
+    plan.write_text(json.dumps({"version": 1, "faults": [
+        {"site": "backend.tpu.dispatch", "kind": "raise", "times": -1}]}))
+    rc = main(["mine", "--difficulty", "8", "--blocks", "2", "--backend",
+               "tpu", "--kernel", "jnp", "--batch-pow2", "11",
+               "--fault-plan", str(plan)])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert out["degraded"] is True and out["degraded_to"] == "cpu"
+    assert out["backend"] == "cpu" and out["height"] == 2
+
+
+def test_cli_checkpoint_every_requires_checkpoint(capsys):
+    from mpi_blockchain_tpu.cli import main
+
+    rc = main(["mine", "--difficulty", "8", "--blocks", "1", "--backend",
+               "cpu", "--checkpoint-every", "1"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 2 and "--checkpoint" in out["error"]
+
+
+def test_cli_resume_replays_heartbeat_and_event(tmp_path, capsys):
+    from mpi_blockchain_tpu.cli import main
+    from mpi_blockchain_tpu.telemetry import default_registry
+    from mpi_blockchain_tpu.telemetry.events import recent_events
+
+    ck = tmp_path / "ck.bin"
+    rc = main(["mine", "--difficulty", "8", "--blocks", "2", "--backend",
+               "cpu", "--checkpoint", str(ck)])
+    assert rc == 0
+    capsys.readouterr()
+    rc = main(["mine", "--difficulty", "8", "--blocks", "3", "--backend",
+               "cpu", "--resume", str(ck)])
+    assert rc == 0
+    assert json.loads(capsys.readouterr().out)["height"] == 3
+    resumed = recent_events(event="checkpoint_resumed")
+    assert resumed and resumed[-1]["height"] == 2
+    hb = default_registry().gauge("miner_heartbeat")
+    assert hb.value == 3 and hb.age_s() is not None
+
+
+def test_cli_sigkill_mid_run_resume_extends_and_verifies(tmp_path):
+    """The recovery-path acceptance test: SIGKILL a checkpointing miner
+    subprocess mid-run, resume from its last (atomic) checkpoint, and
+    the resumed chain must verify and extend."""
+    from mpi_blockchain_tpu.cli import main
+
+    ck = tmp_path / "ck.bin"
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=os.pathsep.join(
+                   p for p in (str(REPO), os.environ.get("PYTHONPATH"))
+                   if p))
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "mpi_blockchain_tpu", "mine",
+         "--difficulty", "10", "--blocks", "4000", "--backend", "cpu",
+         "--checkpoint", str(ck), "--checkpoint-every", "1", "--verbose"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, cwd=str(tmp_path))
+    mined = 0
+    for line in proc.stdout:
+        if '"block_mined"' in line:
+            mined += 1
+            if mined >= 3:
+                break
+    os.kill(proc.pid, signal.SIGKILL)
+    proc.stdout.close()
+    proc.wait()
+    assert mined >= 3
+    height = json.loads(ck.with_suffix(".bin.json").read_text())["height"]
+    assert height >= mined - 1   # --checkpoint-every 1: <= 1 block lost
+    import io
+    from contextlib import redirect_stdout
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = main(["mine", "--difficulty", "10", "--blocks",
+                   str(height + 2), "--backend", "cpu", "--resume",
+                   str(ck), "--out", str(tmp_path / "resumed.bin")])
+    assert rc == 0
+    assert json.loads(buf.getvalue().splitlines()[-1])["height"] == \
+        height + 2
+    node = core.Node(10, 0)
+    assert node.load((tmp_path / "resumed.bin").read_bytes())
+    assert node.height == height + 2
+
+
+def test_cli_sim_fixed_fault_plan_byte_identical_dumps(tmp_path, capsys):
+    from mpi_blockchain_tpu.cli import main
+
+    plan = tmp_path / "plan.json"
+    plan.write_text(json.dumps({"version": 1, "faults": [
+        {"site": "sim.deliver", "kind": "corrupt", "call": 1,
+         "times": 2}]}))
+    for i in range(2):
+        rc = main(["sim", "--blocks", "3", "--partition-steps", "8",
+                   "--seed", "2", "--fault-plan", str(plan),
+                   "--events-dump", str(tmp_path / f"d{i}.json")])
+        assert rc == 0, capsys.readouterr().out
+        capsys.readouterr()
+    assert (tmp_path / "d0.json").read_bytes() == \
+        (tmp_path / "d1.json").read_bytes()
+
+
+def test_cli_verify_accepts_sealed_checkpoint(tmp_path, capsys):
+    from mpi_blockchain_tpu.cli import main
+
+    ck = tmp_path / "ck.bin"
+    main(["mine", "--difficulty", "8", "--blocks", "2", "--backend",
+          "cpu", "--checkpoint", str(ck)])
+    capsys.readouterr()
+    rc = main(["verify", "--chain", str(ck), "--difficulty", "8"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0 and out["valid"] is True and out["sealed"] is True
+
+
+def test_cli_strict_plan_never_masks_a_failing_run(tmp_path, capsys):
+    # A run that already failed keeps its own exit code; the strict
+    # exhaustion check only gates successful runs.
+    from mpi_blockchain_tpu.cli import main
+
+    plan = tmp_path / "plan.json"
+    plan.write_text(json.dumps({"version": 1, "strict": True, "faults": [
+        {"site": "sim.deliver", "kind": "raise", "call": 10 ** 6}]}))
+    rc = main(["mine", "--difficulty", "8", "--blocks", "1", "--backend",
+               "cpu", "--resume", str(tmp_path / "missing.bin"),
+               "--fault-plan", str(plan)])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1 and "error" in out   # NOT rc 3
+
+
+def test_cli_verify_rejects_torn_sealed_checkpoint(tmp_path, capsys):
+    # A sealed checkpoint torn exactly at the trailer boundary must not
+    # verify as a valid shorter chain (the sidecar betrays the tear).
+    from mpi_blockchain_tpu.cli import main
+
+    ck = tmp_path / "ck.bin"
+    main(["mine", "--difficulty", "8", "--blocks", "3", "--backend",
+          "cpu", "--checkpoint", str(ck)])
+    capsys.readouterr()
+    blob = ck.read_bytes()
+    ck.write_bytes(blob[:2 * core.HEADER_SIZE])   # 80-byte-aligned tear
+    rc = main(["verify", "--chain", str(ck), "--difficulty", "8"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1 and out["valid"] is False
+    assert "torn" in out["error"]
+
+
+def test_recover_seal_only_damage_reports_zero_dropped(tmp_path):
+    from mpi_blockchain_tpu.utils.checkpoint import (recover_chain,
+                                                     save_chain)
+
+    miner = _mined()
+    path = tmp_path / "chain.bin"
+    save_chain(miner.node, path, miner.config)
+    blob = path.read_bytes()
+    path.write_bytes(blob[:-48])   # rip ONLY the trailer off
+    node, report = recover_chain(path, 8)
+    assert report["recovered"] is True
+    assert report["dropped_bytes"] == 0 and node.height == 3
+
+
+def test_recover_trailer_only_bitrot_reports_zero_dropped(tmp_path):
+    # Bitrot inside the trailer digest (chain bytes untouched) must
+    # recover with dropped_bytes == 0, not count the 48-byte trailer.
+    from mpi_blockchain_tpu.utils.checkpoint import (recover_chain,
+                                                     save_chain)
+
+    miner = _mined()
+    path = tmp_path / "chain.bin"
+    save_chain(miner.node, path, miner.config)
+    rotted = bytearray(path.read_bytes())
+    rotted[-1] ^= 0x01          # inside the trailer's sha256
+    path.write_bytes(bytes(rotted))
+    node, report = recover_chain(path, 8)
+    assert report["recovered"] is True
+    assert report["dropped_bytes"] == 0 and node.height == 3
+
+
+def test_sidecar_nonnumeric_version_is_checkpoint_error(tmp_path):
+    from mpi_blockchain_tpu.utils.checkpoint import (CheckpointError,
+                                                     load_chain,
+                                                     recover_chain,
+                                                     save_chain)
+
+    miner = _mined()
+    path = tmp_path / "chain.bin"
+    save_chain(miner.node, path, miner.config)
+    sidecar = tmp_path / "chain.bin.json"
+    meta = json.loads(sidecar.read_text())
+    meta["checkpoint_version"] = "two"
+    del meta["payload_sha256"]
+    sidecar.write_text(json.dumps(meta))
+    with pytest.raises(CheckpointError, match="checkpoint_version"):
+        load_chain(path, 8)
+    # The payload is intact, so recovery salvages the full chain.
+    node, report = recover_chain(path, 8)
+    assert node.height == 3 and report["dropped_bytes"] == 0
+
+
+def test_recover_preserves_sidecar_config(tmp_path):
+    from mpi_blockchain_tpu.utils.checkpoint import (recover_chain,
+                                                     save_chain)
+
+    miner = _mined()
+    path = tmp_path / "chain.bin"
+    save_chain(miner.node, path, miner.config)
+    blob = path.read_bytes()
+    path.write_bytes(blob[:-120])
+    recover_chain(path, 8)
+    meta = json.loads((tmp_path / "chain.bin.json").read_text())
+    assert meta["config"]["difficulty_bits"] == 8
+    assert meta["config"]["data_prefix"] == "block"
+
+
+def test_strict_plan_shadowed_spec_still_counts_as_fired():
+    # A spec whose window is fully covered by an earlier times=-1 spec
+    # must not make a strict plan unexhaustible.
+    injection.arm(_plan(
+        {"site": "backend.cpu.search", "kind": "raise", "times": -1},
+        {"site": "backend.cpu.search", "kind": "corrupt", "call": 2},
+        strict=True))
+    for _ in range(3):
+        with pytest.raises(FaultInjected):
+            injection.check("backend.cpu.search")
+    injection.disarm(strict=True)   # must not raise
+
+
+def test_native_load_fault_fires():
+    from mpi_blockchain_tpu.core import build
+
+    injection.arm(_plan({"site": "native.load", "kind": "raise"}))
+    with pytest.raises(FaultInjected):
+        build.ensure_built()
+    injection.disarm()
+    assert build.ensure_built().exists()   # the real library still loads
+
+
+def test_hang_fault_stales_heartbeat_then_raises():
+    from mpi_blockchain_tpu.resilience import FaultTimeout
+
+    injection.arm(_plan({"site": "backend.cpu.search", "kind": "hang",
+                         "seconds": 0.02}))
+    with pytest.raises(FaultTimeout):
+        injection.check("backend.cpu.search")

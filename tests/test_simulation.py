@@ -157,11 +157,14 @@ def test_adversarial_with_tpu_backend_converges_and_matches_cpu():
     tpu_net = run_adversarial(config=tpu_cfg, **kw)
     cpu_net = run_adversarial(config=cpu_cfg, **kw)
     assert tpu_net.converged() and cpu_net.converged()
-    # The sharded device path really ran (not a silent cpu fallback).
+    # The sharded device path really ran (not a silent cpu fallback —
+    # the resilient wrapper's ACTIVE rung must still be the tpu backend,
+    # and the ladder must never have stepped down).
     from mpi_blockchain_tpu.backend.tpu import TpuBackend
-    assert all(isinstance(n.backend, TpuBackend) for n in tpu_net.nodes)
-    assert all(n.backend.mesh is not None and n.backend.n_miners == 2
-               for n in tpu_net.nodes)
+    active = [n.backend.active_backend for n in tpu_net.nodes]
+    assert all(isinstance(b, TpuBackend) for b in active)
+    assert not any(n.backend.degraded for n in tpu_net.nodes)
+    assert all(b.mesh is not None and b.n_miners == 2 for b in active)
     assert [n.node.tip_hash for n in tpu_net.nodes] == \
            [n.node.tip_hash for n in cpu_net.nodes]
     assert tpu_net.step_count == cpu_net.step_count
