@@ -5,9 +5,10 @@ PY ?= python3
 SHELL := /bin/bash   # tier1 uses pipefail/PIPESTATUS
 
 .PHONY: check lint metrics-smoke forensics-smoke perf-smoke chaos-smoke \
-        tier1 core clean
+        adversary-smoke tier1 core clean
 
-check: lint metrics-smoke forensics-smoke perf-smoke chaos-smoke tier1
+check: lint metrics-smoke forensics-smoke perf-smoke chaos-smoke \
+        adversary-smoke tier1
 
 # chainlint: binding contract, header layout, JAX purity, sanitizer matrix.
 lint:
@@ -62,6 +63,46 @@ chaos-smoke:
 	env JAX_PLATFORMS=cpu $(PY) -m mpi_blockchain_tpu.resilience smoke \
 	    2>/dev/null || { echo "chaos-smoke: failed"; exit 1; }; \
 	echo "chaos-smoke: ok"
+
+# Adversary smoke: the ISSUE 6 gate — the vectorized scenario engine runs
+# selfish mining + eclipse + stale-tip flooding (with churn, a partition,
+# and difficulty retargeting) twice with one seed; the two causal dumps
+# must be byte-identical, and the forensics attack audit must show the
+# expected outcomes: withheld-block releases causing reorgs, the eclipse
+# victim recovering onto the canonical chain, and every flood dying in
+# sync_rejected (budget + linkage + bits) with chains untouched.
+adversary-smoke:
+	tmp=$$(mktemp -d); \
+	env JAX_PLATFORMS=cpu $(PY) -m mpi_blockchain_tpu sim \
+	    --preset adversarial-smoke --events-dump $$tmp/a.json \
+	    --metrics-dump $$tmp/metrics.txt >/dev/null 2>&1 || \
+	    { echo "adversary-smoke: adversarial sim failed"; rm -rf $$tmp; exit 1; }; \
+	env JAX_PLATFORMS=cpu $(PY) -m mpi_blockchain_tpu sim \
+	    --preset adversarial-smoke --events-dump $$tmp/b.json \
+	    >/dev/null 2>&1 || \
+	    { echo "adversary-smoke: second run failed"; rm -rf $$tmp; exit 1; }; \
+	cmp -s $$tmp/a.json $$tmp/b.json || \
+	    { echo "adversary-smoke: same-seed causal dumps differ"; rm -rf $$tmp; exit 1; }; \
+	grep -q '^sim_sync_rejected_total [1-9]' $$tmp/metrics.txt || \
+	    { echo "adversary-smoke: sim_sync_rejected_total not exercised"; rm -rf $$tmp; exit 1; }; \
+	env JAX_PLATFORMS=cpu $(PY) -m mpi_blockchain_tpu.forensics \
+	    --events $$tmp/a.json --json > $$tmp/report.json 2>/dev/null || \
+	    { echo "adversary-smoke: forensics CLI failed"; rm -rf $$tmp; exit 1; }; \
+	$(PY) -c "import json; \
+	r = json.load(open('$$tmp/report.json')); \
+	a = r['attack_audit']; \
+	s = a['selfish'][0]; e = a['eclipse'][0]; f = a['flood'][0]; \
+	assert r['fork_tree']['blocks'] and r['fork_tree']['fork_points']; \
+	assert s['withheld_total'] > 0 and s['released_total'] > 0; \
+	assert any(rel['reorgs_caused'] for rel in s['releases']), 'no release reorged'; \
+	assert e['victim_tip_canonical'] and e['post_heal_adopt'], 'eclipse victim stuck'; \
+	assert f['rejections'] > 0 and f['chains_untouched']; \
+	assert set(f['rejections_by_path']) == {'budget', 'linkage', 'bits'}, f['rejections_by_path']; \
+	print('adversary-smoke: ok (%d withheld, %d released, eclipse fork %d, ' \
+	      '%d floods rejected)' % (s['withheld_total'], s['released_total'], \
+	      e['isolated_fork_len'], f['rejections']))" || \
+	    { echo "adversary-smoke: audit assertions failed"; rm -rf $$tmp; exit 1; }; \
+	rm -rf $$tmp
 
 # Perfwatch smoke: serve a faulted instrumented run, scrape /metrics +
 # /healthz live, then prove the regression sentinel flags an injected

@@ -386,6 +386,19 @@ def _run_roofline_section(measured_mhs: float) -> tuple[dict, str | None]:
                               "MBT_ROOFLINE_MHS": str(measured_mhs)})
 
 
+def _run_sim_adversarial_section() -> tuple[dict | None, str | None]:
+    """Vectorized adversarial-sim throughput (in-process, CPU-only, no
+    device involvement): best-of-2 with the spread on the record so the
+    perfwatch sentinel can gate sim steps/sec like mining rate."""
+    try:
+        from mpi_blockchain_tpu.bench_lib import (bench_sim_adversarial,
+                                                  repeat_best)
+        return repeat_best(bench_sim_adversarial, reps=2,
+                           key="steps_per_sec"), None
+    except Exception as e:
+        return None, f"{type(e).__name__}: {e}"
+
+
 # ---- perfwatch history ------------------------------------------------------
 
 def _record_history(fresh: dict, history_path) -> None:
@@ -426,6 +439,15 @@ def main(argv: list[str] | None = None) -> int:
     fresh: dict = {"cpu_np8": cpu}
 
     detail: dict = {"cpu_np8": _round_floats(cpu)}
+
+    sim_adv, sim_adv_err = _run_sim_adversarial_section()
+    if sim_adv is not None:
+        fresh["sim_adversarial"] = sim_adv
+        detail["sim_adversarial"] = _round_floats(
+            {k: v for k, v in sim_adv.items()
+             if not isinstance(v, list)})
+    else:
+        detail["sim_adversarial"] = {"error": sim_adv_err or "no output"}
     if dev_err:
         detail["device_error"] = dev_err
 

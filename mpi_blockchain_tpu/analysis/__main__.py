@@ -16,7 +16,8 @@ from . import default_root, pass_families, run_all
 OVERRIDE_KEYS = ("capi", "ctypes_binding", "pybind", "chain_hpp",
                  "chain_cpp", "core_init", "sha_jnp", "header_test",
                  "mesh_py", "core_makefile", "core_src", "sim_py",
-                 "telemetry_files", "resilience_files")
+                 "telemetry_files", "resilience_files",
+                 "adversary_files")
 
 
 def main(argv: list[str] | None = None) -> int:

@@ -1,4 +1,4 @@
-"""RES rules — swallow-proof fault handling in dispatch/IO paths.
+"""RES rules — swallow-proof fault handling + byte-reproducible attacks.
 
 The resilience layer's whole premise is that dispatch and I/O failures
 reach ONE sanctioned decision point (``resilience/policy.py``'s
@@ -18,12 +18,25 @@ ISSUE 5 exists to kill.
           the policy layer, or at minimum record it (a counter, an
           event, a warning) before moving on.
 
-Scope: the dispatch/IO surface — ``backend/``, ``core/build.py``,
-``core/_ctypes_binding.py``, ``utils/checkpoint.py``,
+  RES002  in the adversarial-simulation package (``sim/`` — scenario,
+          engine, strategies, live-bus attackers), any randomness or
+          time source OUTSIDE the seeded scenario RNG: importing
+          ``random``/``secrets``/``uuid``, calling ``os.urandom``,
+          reading the wall clock (``time.time``/``monotonic``/
+          ``perf_counter``/``*_ns``, ``datetime.now``/``utcnow``/
+          ``today``), or numpy's STATEFUL global RNG surface
+          (``np.random.seed``/``random``/``rand``/``randint``/...).
+          Every attack decision must come from the scenario seed
+          through ``ScenarioRng`` (crc32 / keyed Philox) — that is
+          what keeps a 1000-node adversarial run byte-reproducible,
+          the property the chaos/adversary smoke gates assert.
+
+Scope: RES001 covers the dispatch/IO surface — ``backend/``,
+``core/build.py``, ``core/_ctypes_binding.py``, ``utils/checkpoint.py``,
 ``simulation.py``, ``models/``, ``parallel/distributed.py`` (override
-key ``resilience_files`` — the drift-fixture seam). The sanctioned
-swallow point ``resilience/policy.py`` is deliberately outside the
-scope.
+key ``resilience_files``). RES002 covers ``mpi_blockchain_tpu/sim/``
+(override key ``adversary_files``). The sanctioned swallow point
+``resilience/policy.py`` is deliberately outside both scopes.
 """
 from __future__ import annotations
 
@@ -128,6 +141,117 @@ def _scoped_files(root: pathlib.Path) -> list[pathlib.Path]:
     return files
 
 
+# ---- RES002: seeded-RNG-only adversary paths ------------------------------
+
+#: The adversarial-simulation package RES002 covers.
+ADVERSARY_PATHS = ("mpi_blockchain_tpu/sim",)
+
+#: Modules whose mere import is nondeterminism on an attack path.
+_BANNED_MODULES = {"random", "secrets", "uuid"}
+
+#: attribute-call chains that read the wall clock or OS entropy.
+_BANNED_CALLS = {
+    ("time", "time"), ("time", "monotonic"), ("time", "perf_counter"),
+    ("time", "time_ns"), ("time", "monotonic_ns"),
+    ("time", "perf_counter_ns"),
+    ("datetime", "now"), ("datetime", "utcnow"), ("datetime", "today"),
+    ("os", "urandom"), ("os", "getrandom"),
+}
+
+#: numpy's STATEFUL global-RNG surface (np.random.<name>(...)). The
+#: counter-based constructors (Philox/Generator/SeedSequence/PCG64 and
+#: a SEEDED default_rng) stay legal — they are how ScenarioRng works.
+_BANNED_NP_RANDOM = {
+    "seed", "random", "rand", "randn", "randint", "random_sample",
+    "uniform", "normal", "choice", "shuffle", "permutation", "bytes",
+}
+
+
+def _dotted(node: ast.expr) -> list[str]:
+    """['np', 'random', 'seed'] for np.random.seed — [] when not a
+    plain attribute chain."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        parts.reverse()
+        return parts
+    return []
+
+
+def _scan_adversary_file(root: pathlib.Path,
+                         path: pathlib.Path) -> list[Finding]:
+    rel = (str(path.relative_to(root)) if path.is_relative_to(root)
+           else str(path))
+    try:
+        tree = ast.parse(path.read_text(), filename=str(path))
+    except SyntaxError as e:
+        return [Finding(rel, e.lineno or 1, "RES000",
+                        f"syntax error: {e.msg}")]
+    except OSError:
+        return []
+    findings: list[Finding] = []
+
+    def flag(line: int, what: str) -> None:
+        findings.append(Finding(
+            rel, line, "RES002",
+            f"{what} in an adversary/scenario path breaks "
+            f"byte-reproducibility — draw from the seeded ScenarioRng "
+            f"(crc32 / keyed Philox) instead"))
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name.split(".")[0] in _BANNED_MODULES:
+                    flag(node.lineno, f"import of {alias.name!r}")
+        elif isinstance(node, ast.ImportFrom):
+            if node.module and node.module.split(".")[0] \
+                    in _BANNED_MODULES:
+                flag(node.lineno, f"import from {node.module!r}")
+            elif node.module:
+                # Bare from-imports of banned members (`from time
+                # import time`) would otherwise dodge the dotted-call
+                # check below — flag them at the import site.
+                mod = node.module.split(".")[0]
+                for alias in node.names:
+                    if (mod, alias.name) in _BANNED_CALLS:
+                        flag(node.lineno,
+                             f"from-import of wall-clock/entropy "
+                             f"{mod}.{alias.name}")
+        elif isinstance(node, ast.Call):
+            parts = _dotted(node.func)
+            if not parts:
+                continue
+            tail = tuple(parts[-2:])
+            if len(parts) >= 2 and tail in _BANNED_CALLS:
+                flag(node.lineno, f"wall-clock/entropy call "
+                                  f"{'.'.join(parts)}()")
+            elif len(parts) >= 3 and parts[-2] == "random" and \
+                    parts[-1] in _BANNED_NP_RANDOM:
+                flag(node.lineno, f"stateful global-RNG call "
+                                  f"{'.'.join(parts)}()")
+            elif parts[-1] == "default_rng" and not node.args \
+                    and not node.keywords:
+                # Bare (from-imported) calls too: len(parts) may be 1.
+                flag(node.lineno, "unseeded default_rng() (OS "
+                                  "entropy)")
+    return findings
+
+
+def _adversary_files(root: pathlib.Path) -> list[pathlib.Path]:
+    files: list[pathlib.Path] = []
+    for entry in ADVERSARY_PATHS:
+        p = root / entry
+        if p.is_dir():
+            files.extend(sorted(q for q in p.rglob("*.py")
+                                if "__pycache__" not in q.parts))
+        elif p.exists():
+            files.append(p)
+    return files
+
+
 def run_resilience_lint(root: pathlib.Path, overrides=None,
                         notes=None) -> list[Finding]:
     overrides = overrides or {}
@@ -139,4 +263,11 @@ def run_resilience_lint(root: pathlib.Path, overrides=None,
     findings: list[Finding] = []
     for path in files:
         findings.extend(_scan_file(root, pathlib.Path(path)))
+    adversary = overrides.get("adversary_files")
+    if adversary is None:
+        adversary = _adversary_files(root)
+    elif isinstance(adversary, (str, pathlib.Path)):
+        adversary = [pathlib.Path(adversary)]
+    for path in adversary:
+        findings.extend(_scan_adversary_file(root, pathlib.Path(path)))
     return findings

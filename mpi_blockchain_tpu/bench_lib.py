@@ -300,6 +300,35 @@ def bench_tpu_single() -> dict:
                 miner.node.tip_hash == oracle.node.tip_hash}
 
 
+def bench_sim_adversarial(preset: str = "adversarial-bench") -> dict:
+    """One timed run of the vectorized adversarial scenario engine — the
+    ``sim_adversarial`` bench section. steps/sec is the headline: the
+    perfwatch sentinel gates sim throughput with it exactly like it
+    gates mining (ISSUE 6). The scenario is a FIXED preset (churn +
+    retargeting + selfish/eclipse/flood all live), so the number prices
+    the engine, and the summary invariants double as a correctness
+    canary — a non-converged or attack-free run records loudly.
+    """
+    from .sim import SCENARIO_PRESETS, run_scenario
+
+    scenario = SCENARIO_PRESETS[preset]
+    t0 = time.perf_counter()
+    net, summary = run_scenario(scenario)
+    wall = time.perf_counter() - t0
+    return {
+        "preset": preset,
+        "n_nodes": scenario.n_nodes,
+        "steps": scenario.steps,
+        "wall_s": round(wall, 3),
+        "steps_per_sec": round(scenario.steps / wall, 1),
+        "converged": summary["converged"],
+        "blocks_total": summary["blocks_total"],
+        "final_bits": summary["final_bits"],
+        "sync_rejections": summary["sync_rejections"],
+        "reorgs": summary["reorgs"],
+    }
+
+
 def repeat_best(measure, reps: int = 2, key: str = "hashes_per_sec",
                 minimize: bool = False, prior: list | None = None) -> dict:
     """Runs measure() reps times and returns the best run's payload (min

@@ -20,6 +20,12 @@ import time
 from .config import ConfigError, MinerConfig, PRESETS
 from .resilience import FaultPlanError, RetryExhausted
 
+#: Vectorized-scenario preset names (sim.scenario.SCENARIO_PRESETS),
+#: duplicated as a literal so building the arg parser never imports
+#: numpy; a test asserts the two stay in sync.
+SCENARIO_PRESET_NAMES = ("adversarial-1k", "adversarial-bench",
+                         "adversarial-smoke")
+
 
 def _batch_pow2_arg(s: str):
     if s == "auto":
@@ -306,11 +312,144 @@ def cmd_verify(args) -> int:
     return 0 if ok else 1
 
 
+def _sim_scenario_from(args):
+    """Resolves the vectorized-engine scenario: a named scenario preset,
+    or an ad-hoc one from --nodes/--steps/strategy/churn/retarget flags.
+    Returns None when the legacy (real-chain) bus should run instead."""
+    import dataclasses as _dc
+
+    from .sim import (SCENARIO_PRESETS, AdversarySpec, ChurnSchedule,
+                      LatencySpec, RetargetRule, Scenario)
+
+    if args.preset in SCENARIO_PRESETS:
+        if args.nodes is not None:
+            raise ConfigError(
+                f"--nodes cannot resize scenario preset {args.preset} "
+                f"(its partitions/churn/adversaries are sized to "
+                f"{SCENARIO_PRESETS[args.preset].n_nodes} nodes); "
+                f"build an ad-hoc scenario with --nodes alone")
+        sc = SCENARIO_PRESETS[args.preset]
+        # Every explicitly-passed flag OVERRIDES the preset (an
+        # explicit 0 wins too — the defaults are None sentinels); a
+        # silently-dropped --strategy would be an attack that never ran.
+        seed = sc.seed if args.seed is None else args.seed
+        steps = sc.steps if args.steps is None else args.steps
+        over: dict = {"seed": seed, "steps": steps}
+        if args.difficulty is not None:
+            over["difficulty_bits"] = args.difficulty
+        if args.hashes_per_step is not None:
+            over["hashes_per_step"] = args.hashes_per_step
+        if args.converge_margin is not None:
+            over["converge_margin"] = args.converge_margin
+        if args.drop_rate is not None:
+            over["drop_rate_pct"] = args.drop_rate
+        if args.latency is not None:
+            over["latency"] = LatencySpec.parse(args.latency)
+        if args.retarget is not None:
+            over["retarget"] = RetargetRule.parse(args.retarget)
+        if args.strategy:
+            over["adversaries"] = tuple(AdversarySpec.parse(s)
+                                        for s in args.strategy)
+        if args.churn is not None:
+            over["churn"] = ChurnSchedule.from_seed(
+                seed, sc.n_nodes, steps, args.churn)
+        return _dc.replace(sc, **over)
+    if args.nodes is None:
+        # Legacy bus it is — but vectorized-engine-only flags must not
+        # be silently ignored (a "flood attack" that never ran).
+        vec_only = [flag for flag, value in (
+            ("--strategy", args.strategy), ("--churn", args.churn),
+            ("--steps", args.steps),
+            ("--latency", args.latency),
+            ("--hashes-per-step", args.hashes_per_step),
+            ("--converge-margin", args.converge_margin))
+            if value is not None and value != []]
+        if vec_only:
+            raise ConfigError(
+                f"{'/'.join(vec_only)} need the vectorized engine: "
+                f"pass --nodes N or a scenario preset "
+                f"({', '.join(sorted(SCENARIO_PRESETS))})")
+        return None
+    if args.preset:
+        # A legacy MinerConfig preset composed with --nodes would be
+        # silently discarded by the vec engine — refuse instead.
+        raise ConfigError(
+            f"--preset {args.preset} is a legacy mining preset; with "
+            f"--nodes use a scenario preset "
+            f"({', '.join(sorted(SCENARIO_PRESETS))}) or drop --nodes")
+    seed = 0 if args.seed is None else args.seed
+    steps = 1000 if args.steps is None else args.steps
+    return Scenario(
+        n_nodes=args.nodes,
+        steps=steps,
+        seed=seed,
+        difficulty_bits=(16 if args.difficulty is None
+                         else args.difficulty),
+        hashes_per_step=(32 if args.hashes_per_step is None
+                         else args.hashes_per_step),
+        retarget=(RetargetRule.parse(args.retarget)
+                  if args.retarget else None),
+        latency=LatencySpec.parse(args.latency or "1"),
+        drop_rate_pct=args.drop_rate or 0,
+        churn=ChurnSchedule.from_seed(seed, args.nodes, steps,
+                                      args.churn or 0),
+        adversaries=tuple(AdversarySpec.parse(s)
+                          for s in (args.strategy or [])),
+        converge_margin=(1000 if args.converge_margin is None
+                         else args.converge_margin),
+    )
+
+
+def _cmd_sim_vec(args, scenario) -> int:
+    """The vectorized scenario engine behind ``sim`` (1000-node scale)."""
+    from .sim import run_scenario
+    from .telemetry import flight_recorder
+
+    held: dict = {}
+
+    def _on_network(net) -> None:
+        held["net"] = net
+        if flight_recorder.installed():
+            flight_recorder.register_network(net)
+
+    t0 = time.perf_counter()
+    net, summary = run_scenario(scenario, on_network=_on_network)
+    wall = time.perf_counter() - t0
+    if args.events_dump:
+        try:
+            net.dump_causal(args.events_dump,
+                            meta={"preset": args.preset})
+        except OSError as e:
+            print(f"events-dump failed: {e}", file=sys.stderr)
+    summary["wall_s"] = round(wall, 3)
+    summary["steps_per_sec"] = round(scenario.steps / wall, 1) if wall \
+        else None
+    print(json.dumps(summary, sort_keys=True))
+    if not summary["converged"]:
+        flight_recorder.dump_now("vec sim non-convergence at cutoff")
+        return 1
+    return 0
+
+
 def cmd_sim(args) -> int:
-    """BASELINE config 5 from the command line: adversarial partition+reorg."""
+    """BASELINE config 5 from the command line: adversarial partition+reorg.
+    Scenario presets (``--preset adversarial-1k``) and --nodes route to
+    the vectorized engine instead of the real-chain bus."""
     from .simulation import run_adversarial
     from .telemetry import flight_recorder
 
+    scenario = _sim_scenario_from(args)
+    if scenario is not None:
+        return _cmd_sim_vec(args, scenario)
+    if args.seed is None:     # legacy bus: plain defaults
+        args.seed = 0
+    if args.drop_rate is None:
+        args.drop_rate = 0
+
+    retarget = None
+    if args.retarget:
+        from .sim import RetargetRule
+        retarget = RetargetRule.parse(args.retarget)
     if args.preset:
         cfg = PRESETS[args.preset]
         target_height = cfg.n_blocks
@@ -354,6 +493,7 @@ def cmd_sim(args) -> int:
                               delay_steps=args.delay_steps,
                               drop_rate_pct=args.drop_rate,
                               seed=args.seed, n_groups=args.groups,
+                              retarget=retarget,
                               on_network=_on_network)
     except RuntimeError as e:  # Network.run: no convergence in max_steps
         if not hasattr(e, "network"):
@@ -502,9 +642,15 @@ def main(argv: list[str] | None = None) -> int:
     p_bench.set_defaults(fn=cmd_bench)
 
     p_sim = sub.add_parser(
-        "sim", help="adversarial 2-group partition + longest-chain reorg "
-                    "simulation (BASELINE config 5)")
-    p_sim.add_argument("--preset", choices=sorted(PRESETS))
+        "sim", help="adversarial simulation: the config-5 partition+reorg "
+                    "bus, or the vectorized 1000-node scenario engine "
+                    "(--preset adversarial-1k / --nodes N)")
+    # Static name list: importing sim.scenario here would pull numpy
+    # into EVERY CLI invocation (mine/verify/--help). A test pins this
+    # literal against sim.SCENARIO_PRESETS so it cannot drift.
+    p_sim.add_argument("--preset",
+                       choices=sorted(PRESETS) + sorted(
+                           SCENARIO_PRESET_NAMES))
     p_sim.add_argument("--difficulty", type=int, default=None,
                        help="leading-zero bits (default: sim-internal 8)")
     p_sim.add_argument("--blocks", type=int, default=8,
@@ -519,12 +665,45 @@ def main(argv: list[str] | None = None) -> int:
                        help="log2 nonces each group tries per sim step")
     p_sim.add_argument("--delay-steps", type=int, default=1,
                        help="delivery delay in sim steps")
-    p_sim.add_argument("--drop-rate", type=int, default=0,
-                       help="%% of deliveries dropped (seeded, deterministic)")
-    p_sim.add_argument("--seed", type=int, default=0,
-                       help="seed for the drop schedule")
+    p_sim.add_argument("--drop-rate", type=int, default=None,
+                       help="%% of deliveries dropped (seeded, "
+                            "deterministic; default 0)")
+    p_sim.add_argument("--seed", type=int, default=None,
+                       help="seed for the drop/scenario schedules "
+                            "(default 0; overrides a scenario preset's "
+                            "baked-in seed when given, 0 included)")
     p_sim.add_argument("--groups", type=int, default=2,
                        help="number of competing miner groups")
+    p_sim.add_argument("--retarget", metavar="INT[:STEP[:MAX]]",
+                       default=None,
+                       help="height-scheduled difficulty retargeting: "
+                            "+STEP bits every INT blocks, capped at MAX "
+                            "(validated on sync adoption, both engines)")
+    p_sim.add_argument("--nodes", type=int, default=None,
+                       help="vectorized engine: network size (switches "
+                            "sim to the batched scenario engine)")
+    p_sim.add_argument("--steps", type=int, default=None,
+                       help="vectorized engine: scenario horizon in "
+                            "steps (default 1000)")
+    p_sim.add_argument("--strategy", action="append", metavar="SPEC",
+                       help="vectorized engine: adversary strategy, "
+                            "repeatable — selfish:node=1,hashrate=8 | "
+                            "eclipse:node=2,victim=5,start=50,until=120 "
+                            "| flood:node=3,every=25")
+    p_sim.add_argument("--churn", type=int, default=None, metavar="N",
+                       help="vectorized engine: N seeded crash-restart "
+                            "churn events across the horizon")
+    p_sim.add_argument("--latency", default=None, metavar="N|LO-HI",
+                       help="vectorized engine: delivery delay steps, "
+                            "fixed (N, default 1) or seeded uniform "
+                            "(LO-HI)")
+    p_sim.add_argument("--hashes-per-step", type=int, default=None,
+                       help="vectorized engine: per-node hashes/step in "
+                            "the mining lottery (default 32)")
+    p_sim.add_argument("--converge-margin", type=int, default=None,
+                       help="vectorized engine: fault-free "
+                            "reconciliation steps granted past the "
+                            "horizon (default 1000)")
     p_sim.add_argument("--events-dump", metavar="PATH", default=None,
                        help="write every node's Lamport-stamped causal "
                             "event log to PATH on exit (read with "

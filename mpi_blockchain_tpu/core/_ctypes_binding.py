@@ -64,6 +64,11 @@ _lib.cc_node_load.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                               ctypes.c_uint64]
 _lib.cc_node_load.restype = ctypes.c_int
 _lib.cc_node_rollback.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+_lib.cc_node_set_retarget.argtypes = [ctypes.c_void_p, ctypes.c_uint32,
+                                      ctypes.c_uint32, ctypes.c_uint32]
+_lib.cc_node_set_retarget.restype = ctypes.c_int
+_lib.cc_node_next_bits.argtypes = [ctypes.c_void_p]
+_lib.cc_node_next_bits.restype = ctypes.c_uint32
 
 
 def _out_buf(n: int):
@@ -209,6 +214,18 @@ class Node:
 
     def rollback(self, new_height: int) -> None:
         _lib.cc_node_rollback(self._h, new_height)
+
+    def set_retarget(self, interval: int, step: int = 1,
+                     max_bits: int = 0) -> bool:
+        """Arms the height-scheduled difficulty-retarget rule (interval 0
+        disables). False once blocks beyond genesis exist — the rule is
+        frozen with history."""
+        return bool(_lib.cc_node_set_retarget(self._h, interval, step,
+                                              max_bits))
+
+    def next_bits(self) -> int:
+        """Bits the NEXT block (height+1) must carry under the rule."""
+        return _lib.cc_node_next_bits(self._h)
 
     def all_headers(self) -> list[bytes]:
         """Headers for heights 1..tip (the adopt_chain wire format)."""

@@ -156,15 +156,35 @@ uint64_t cc_node_save(void* node, uint8_t* out) {
   return bytes.size() / kHeaderSize;
 }
 
-// Restores chain state from concatenated headers (validates everything).
-// Returns 1 on success.
+// Restores chain state from concatenated headers (validates everything,
+// under the node's CURRENT retarget rule). Returns 1 on success.
 int cc_node_load(void* node, const uint8_t* bytes, uint64_t n_headers) {
   Node* nd = static_cast<Node*>(node);
   std::vector<uint8_t> buf(bytes, bytes + n_headers * kHeaderSize);
   Chain fresh(nd->chain().difficulty_bits());
-  if (!Chain::load(buf, nd->chain().difficulty_bits(), &fresh)) return 0;
+  if (!Chain::load(buf, nd->chain().difficulty_bits(), &fresh,
+                   nd->chain().retarget_interval(),
+                   nd->chain().retarget_step(),
+                   nd->chain().retarget_max_bits()))
+    return 0;
   nd->mutable_chain() = std::move(fresh);
   return 1;
+}
+
+// Arms the height-scheduled difficulty-retarget rule (Chain::set_retarget;
+// interval 0 disables). Returns 1 on success, 0 when blocks beyond genesis
+// already exist (the rule is frozen once history does).
+int cc_node_set_retarget(void* node, uint32_t interval, uint32_t step,
+                         uint32_t max_bits) {
+  return static_cast<Node*>(node)->set_retarget(interval, step, max_bits)
+             ? 1
+             : 0;
+}
+
+// The difficulty bits the NEXT block (height+1) must carry under the
+// chain's retarget rule — the search backend's target.
+uint32_t cc_node_next_bits(void* node) {
+  return static_cast<Node*>(node)->next_bits();
 }
 
 void cc_node_rollback(void* node, uint64_t new_height) {

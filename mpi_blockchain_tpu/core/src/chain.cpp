@@ -84,11 +84,37 @@ int64_t Chain::find(const uint8_t hash[32]) const {
   return it == index_.end() ? -1 : int64_t(it->second);
 }
 
+bool Chain::set_retarget(uint32_t interval, uint32_t step,
+                         uint32_t max_bits) {
+  // Changing the rule once non-genesis blocks exist would retroactively
+  // re-judge history under a different schedule; refuse.
+  if (height() > 0) return false;
+  retarget_interval_ = interval;
+  retarget_step_ = step;
+  retarget_max_bits_ = max_bits;
+  return true;
+}
+
+uint32_t Chain::expected_bits(uint64_t height) const {
+  if (retarget_interval_ == 0 || height == 0) return difficulty_bits_;
+  // 64-bit accumulate: a hostile height can never overflow back under
+  // the clamp.
+  uint64_t bits = uint64_t(difficulty_bits_) +
+                  uint64_t(retarget_step_) * (height / retarget_interval_);
+  uint64_t cap = retarget_max_bits_ ? retarget_max_bits_ : 255;
+  if (cap < difficulty_bits_) cap = difficulty_bits_;
+  if (bits > cap) bits = cap;
+  return uint32_t(bits);
+}
+
 bool Chain::valid_child(const BlockHeader& header, const Block& parent) const {
   if (header.version != kVersion) return false;
   if (std::memcmp(header.prev_hash, parent.hash, 32) != 0) return false;
   if (header.timestamp != uint32_t(parent.height + 1)) return false;
-  if (header.bits != difficulty_bits_) return false;
+  // The retarget schedule is enforced HERE, on every adoption path —
+  // append, try_adopt, and try_adopt_from all funnel through valid_child,
+  // so a synced suffix is judged under the same rule as a local submit.
+  if (header.bits != expected_bits(parent.height + 1)) return false;
   return header.meets_difficulty();
 }
 
@@ -160,9 +186,11 @@ std::vector<uint8_t> Chain::headers_from(uint64_t from_height) const {
 }
 
 bool Chain::load(const std::vector<uint8_t>& bytes, uint32_t difficulty_bits,
-                 Chain* out) {
+                 Chain* out, uint32_t retarget_interval,
+                 uint32_t retarget_step, uint32_t retarget_max_bits) {
   if (bytes.empty() || bytes.size() % kHeaderSize != 0) return false;
   Chain fresh(difficulty_bits);
+  fresh.set_retarget(retarget_interval, retarget_step, retarget_max_bits);
   // Byte 0..79 must be exactly our deterministic genesis.
   uint8_t genesis_buf[kHeaderSize];
   fresh.blocks_[0].header.serialize(genesis_buf);
@@ -183,7 +211,7 @@ BlockHeader Node::make_candidate(const uint8_t* data, size_t len) const {
   std::memcpy(h.prev_hash, chain_.tip().hash, 32);
   sha256d(data, len, h.data_hash);
   h.timestamp = uint32_t(chain_.height() + 1);
-  h.bits = chain_.difficulty_bits();
+  h.bits = chain_.expected_bits(chain_.height() + 1);
   h.nonce = 0;
   return h;
 }

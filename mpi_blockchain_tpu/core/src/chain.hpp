@@ -71,6 +71,26 @@ class Chain {
   const Block& at(uint64_t h) const { return blocks_[h]; }
   uint32_t difficulty_bits() const { return difficulty_bits_; }
 
+  // Height-scheduled difficulty retargeting (ISSUE 6). Timestamps are
+  // structural (== height), so the only retarget rule every validator can
+  // agree on from header bytes alone is a pure function of height:
+  //
+  //   expected_bits(h) = min(difficulty_bits + step * (h / interval),
+  //                          max_bits)            for h >= 1
+  //   expected_bits(0) = difficulty_bits          (genesis, PoW-exempt)
+  //
+  // interval == 0 disables retargeting (the default; expected_bits is then
+  // the constant difficulty_bits — existing chains are byte-unchanged).
+  // The schedule is enforced by valid_child, i.e. on EVERY adoption path
+  // (append, try_adopt, try_adopt_from), not just on locally mined blocks.
+  // Returns false (rule unchanged) if blocks beyond genesis already exist:
+  // changing the rule mid-chain would retroactively invalidate history.
+  bool set_retarget(uint32_t interval, uint32_t step, uint32_t max_bits);
+  uint32_t expected_bits(uint64_t height) const;
+  uint32_t retarget_interval() const { return retarget_interval_; }
+  uint32_t retarget_step() const { return retarget_step_; }
+  uint32_t retarget_max_bits() const { return retarget_max_bits_; }
+
   // Validates `header` as the next block (linkage, deterministic timestamp,
   // bits, PoW) and appends. Returns false (chain unchanged) if invalid.
   bool append(const BlockHeader& header);
@@ -108,9 +128,12 @@ class Chain {
   // implementation both bindings expose.
   std::vector<uint8_t> headers_from(uint64_t from_height) const;
   // Rebuilds a chain from saved bytes; validates everything above genesis.
-  // Returns false if the bytes do not form a valid chain.
+  // Returns false if the bytes do not form a valid chain. The optional
+  // retarget triple re-arms the schedule the saved chain was mined under
+  // (0/0/0 = no retargeting), so validation judges it by its own rule.
   static bool load(const std::vector<uint8_t>& bytes, uint32_t difficulty_bits,
-                   Chain* out);
+                   Chain* out, uint32_t retarget_interval = 0,
+                   uint32_t retarget_step = 0, uint32_t retarget_max_bits = 0);
 
  private:
   void index_add(const Block& b);
@@ -119,6 +142,10 @@ class Chain {
   // block hash (32 raw bytes) -> height; kept in sync by every mutation.
   std::unordered_map<std::string, uint64_t> index_;
   uint32_t difficulty_bits_;
+  // Retarget schedule (0/0/0 = disabled; see set_retarget above).
+  uint32_t retarget_interval_ = 0;
+  uint32_t retarget_step_ = 0;
+  uint32_t retarget_max_bits_ = 0;
 };
 
 // Result of handing a peer's block to a Node (SURVEY.md §3.3).
@@ -144,6 +171,15 @@ class Node {
   const Chain& chain() const { return chain_; }
   int id() const { return id_; }
   uint64_t height() const { return chain_.height(); }
+
+  // Arms the chain's height-scheduled retarget rule (see Chain::
+  // set_retarget); call before any block beyond genesis exists.
+  bool set_retarget(uint32_t interval, uint32_t step, uint32_t max_bits) {
+    return chain_.set_retarget(interval, step, max_bits);
+  }
+  // The bits the NEXT block (height()+1) must carry under the rule —
+  // what a search backend must target.
+  uint32_t next_bits() const { return chain_.expected_bits(height() + 1); }
 
   // Builds the candidate header for the next block: prev = tip hash,
   // data_hash = sha256d(data), timestamp = height+1, bits = difficulty,

@@ -209,10 +209,28 @@ PYBIND11_MODULE(chaincore_pb, m) {
              if (blob.empty() || blob.size() % kHeaderSize != 0) return false;
              std::vector<uint8_t> buf(blob.begin(), blob.end());
              Chain fresh(n.chain().difficulty_bits());
-             if (!Chain::load(buf, n.chain().difficulty_bits(), &fresh))
+             // Validate under the node's CURRENT retarget rule, so a
+             // retargeted chain round-trips through save()/load().
+             if (!Chain::load(buf, n.chain().difficulty_bits(), &fresh,
+                              n.chain().retarget_interval(),
+                              n.chain().retarget_step(),
+                              n.chain().retarget_max_bits()))
                return false;
              n.mutable_chain() = std::move(fresh);
              return true;
+           })
+      .def("set_retarget",
+           [](Node& n, uint32_t interval, uint32_t step, uint32_t max_bits) {
+             // Height-scheduled difficulty retargeting (Chain::
+             // set_retarget; interval 0 disables). False once blocks
+             // beyond genesis exist — the rule is frozen with history.
+             return n.set_retarget(interval, step, max_bits);
+           },
+           py::arg("interval"), py::arg("step") = 1, py::arg("max_bits") = 0)
+      .def("next_bits",
+           [](const Node& n) {
+             // Bits the NEXT block (height+1) must carry under the rule.
+             return n.next_bits();
            })
       .def("rollback",
            [](Node& n, uint64_t new_height) {

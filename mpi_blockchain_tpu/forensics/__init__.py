@@ -32,6 +32,7 @@ reports — the determinism tests assert this.
 from __future__ import annotations
 
 from ..telemetry.causal import load_causal_dump  # noqa: F401
+from .attack_audit import attack_audit  # noqa: F401
 from .fork_tree import (build_fork_tree, convergence_stats,  # noqa: F401
                         reorg_audit)
 from .merge import merge_events, node_order  # noqa: F401
@@ -39,7 +40,10 @@ from .trace_export import to_chrome_trace  # noqa: F401
 
 
 def analyze_dump(dump: dict) -> dict:
-    """The full forensics report for one causal dump (the CLI's payload)."""
+    """The full forensics report for one causal dump (the CLI's payload).
+    Dumps carrying ``attack_*`` events (the adversarial scenario engine,
+    the live-bus attackers) additionally get the attack audit: what each
+    selfish/eclipse/flood strategy did and what it achieved."""
     merged = merge_events(dump)
     tree = build_fork_tree(merged)
     return {
@@ -49,4 +53,5 @@ def analyze_dump(dump: dict) -> dict:
         "fork_tree": tree,
         "reorg_audit": reorg_audit(merged, tree),
         "convergence": convergence_stats(merged, tree),
+        "attack_audit": attack_audit(merged, tree),
     }

@@ -44,6 +44,38 @@ def _human_report(report: dict, out) -> None:
     print(f"announcements: {conv['announcements']}, "
           f"deliveries: {conv['deliveries']}, "
           f"latency steps p50/max: {lat['p50']}/{lat['max']}", file=out)
+    attacks = report.get("attack_audit") or {}
+    if attacks:
+        print("attacks:", file=out)
+        for s in attacks.get("selfish", []):
+            print(f"  selfish node {s['node']}: withheld "
+                  f"{s['withheld_total']}, released {s['released_total']} "
+                  f"in {len(s['releases'])} release(s), abandoned "
+                  f"{s['abandoned_total']}; revenue {s['revenue_blocks']} "
+                  f"canonical blocks ({s['revenue_share']:.1%})",
+                  file=out)
+            for r in s["releases"]:
+                print(f"    release step {r['step']}: {r['count']} "
+                      f"block(s) -> {r['reorgs_caused']} reorg(s), max "
+                      f"depth {r['max_reorg_depth']} (tip {r['tip']})",
+                      file=out)
+        for e in attacks.get("eclipse", []):
+            heal = e["post_heal_adopt"]
+            heal_s = ("no post-heal adopt" if heal is None else
+                      f"post-heal adopt at step {heal['step']} rolled "
+                      f"back {heal['rolled_back']} for {heal['adopted']}")
+            print(f"  eclipse {e['attacker']} -> victim {e['victim']} "
+                  f"window {e['window']}: isolated fork "
+                  f"{e['isolated_fork_len']} block(s) "
+                  f"({','.join(e['isolated_fork']) or 'none'}); {heal_s}; "
+                  f"victim tip canonical: {e['victim_tip_canonical']}",
+                  file=out)
+        for f in attacks.get("flood", []):
+            paths = ", ".join(f"{k}={v}" for k, v in
+                              f["rejections_by_path"].items())
+            print(f"  flood node {f['node']}: {f['attacks']} attack(s), "
+                  f"{f['rejections']} rejection(s) [{paths}]; chains "
+                  f"untouched: {f['chains_untouched']}", file=out)
     print(f"reorgs: {conv['reorgs']}", file=out)
     for a in report["reorg_audit"]:
         loss = ("dropped=" + ",".join(a["announcements_dropped"])

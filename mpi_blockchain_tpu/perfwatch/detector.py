@@ -34,7 +34,11 @@ DEFAULT_SPREAD_K = 2.0
 # CPU sample load-drifts 0.8-1.8 MH/s on a shared box — BASELINE.md
 # demoted it from the headline for exactly this reason — so its series
 # only gates catastrophic host regressions, not scheduler weather.
-SECTION_FLOOR_PCT = {"cpu_np8": 60.0}
+# sim_adversarial runs in-process on the same shared host CPU, so its
+# steps/sec inherits the identical load spread: same 60% floor — the
+# sentinel gates engine regressions (an accidental O(n^2) bus), not
+# scheduler weather.
+SECTION_FLOOR_PCT = {"cpu_np8": 60.0, "sim_adversarial": 60.0}
 
 
 @dataclasses.dataclass(frozen=True)

@@ -20,6 +20,7 @@ wall-clock, lower is better):
     tpu_single      hashes_per_sec            higher
     sharded_pallas  blocks_per_sec            higher
     cpu_np8         hashes_per_sec            higher
+    sim_adversarial steps_per_sec             higher
     utilization     (recorded, never checked: derived from sweep)
 
 Seeding: ``seed_from_bench_rounds`` imports the repo's existing
@@ -47,6 +48,7 @@ SECTION_METRICS: dict[str, tuple[str, str | None]] = {
     "tpu_single": ("hashes_per_sec", "higher"),
     "sharded_pallas": ("blocks_per_sec", "higher"),
     "cpu_np8": ("hashes_per_sec", "higher"),
+    "sim_adversarial": ("steps_per_sec", "higher"),
     "utilization": ("vpu_utilization_pct", None),
 }
 
@@ -170,6 +172,7 @@ _DETAIL_SECTIONS = {
     "tpu_single": "tpu_single",
     "sharded_pallas": "sharded_pallas",
     "cpu_np8": "cpu_np8",
+    "sim_adversarial": "sim_adversarial",
     "utilization": "utilization",
 }
 

@@ -42,6 +42,10 @@ SITES = (
     "checkpoint.write",       # utils/checkpoint.save_chain
     "checkpoint.read",        # utils/checkpoint.load_chain
     "distributed.init",       # parallel/distributed.init_distributed
+    "sim.churn",              # sim/vecnet.VecNetwork, once per step:
+    #                           a fired fault crash-restarts a
+    #                           seeded-chosen live node (corrupt/partial
+    #                           damage kinds; raise/hang crash the step)
 )
 
 KINDS = ("raise", "hang", "corrupt", "partial")

@@ -92,13 +92,24 @@ def locator_heights(tip: int) -> list[int]:
 
 
 class SimNode:
-    """One miner group in the simulation: a C++ Node + backend + progress."""
+    """One miner group in the simulation: a C++ Node + backend + progress.
+
+    ``retarget`` (a ``sim.retarget.RetargetRule``) arms the C++ chain's
+    height-scheduled difficulty rule: candidates carry the scheduled
+    bits (the search targets whatever the candidate demands), and the
+    C++ ``valid_child`` enforces the schedule on every adoption path —
+    local submits AND synced suffixes — so a peer serving wrong-bits
+    headers is rejected exactly like one serving bad PoW.
+    """
 
     def __init__(self, node_id: int, config: MinerConfig,
-                 backend: MinerBackend | None = None):
+                 backend: MinerBackend | None = None, retarget=None):
         self.id = node_id
         self.config = config
+        self.retarget = retarget
         self.node = core.Node(config.difficulty_bits, node_id)
+        if retarget is not None:
+            retarget.apply(self.node)
         if backend is None:  # honor the config's plugin choice (cli `sim
             # --backend tpu` runs the device sweep inside each group);
             # each group is ONE rank, so the cpu pool stays unthreaded
@@ -141,7 +152,10 @@ class SimNode:
             self._extra_nonce = 0
             self._tip_at_start = tip
         cand = self._candidate()
-        res = self.backend.search(cand, self.config.difficulty_bits,
+        # The candidate's own bits field IS the target: under a retarget
+        # rule the C++ make_candidate stamps the scheduled bits for the
+        # next height; without one it equals config.difficulty_bits.
+        res = self.backend.search(cand, core.HeaderFields.unpack(cand).bits,
                                   start_nonce=self._next_nonce,
                                   max_count=nonce_budget)
         if res.nonce is None:
@@ -240,7 +254,7 @@ class SimNode:
         if reason is not None:
             self._reject_sync(peer, anchor, len(suffix), reason)
             return
-        res = self._adopt(anchor, suffix, own_height)
+        res = self._adopt(anchor, suffix, own_height, peer=peer.id)
         if res == core.RecvResult.INVALID and anchor > 0:
             full = peer.node.all_headers()
             serve = peer.causal.record("serve_headers",
@@ -256,7 +270,7 @@ class SimNode:
             if reason is not None:
                 self._reject_sync(peer, 0, len(full), reason)
                 return
-            self._adopt(0, full, own_height)
+            self._adopt(0, full, own_height, peer=peer.id)
 
     def _validate_suffix(self, anchor: int,
                          suffix: list[bytes]) -> str | None:
@@ -280,6 +294,17 @@ class SimNode:
             fields = core.HeaderFields.unpack(header)
             if fields.prev_hash != prev:
                 return f"header-chain linkage broken at offset {i}"
+            if self.retarget is not None:
+                expected = self.retarget.expected_bits(
+                    self.config.difficulty_bits, anchor + 1 + i)
+                if fields.bits != expected:
+                    # The C++ valid_child would reject this too, but
+                    # only after the anchor walk; pre-checking here
+                    # gives the rejection a distinct causal reason the
+                    # forensics attack audit can count.
+                    return (f"retarget bits mismatch at offset {i}: "
+                            f"got {fields.bits}, schedule demands "
+                            f"{expected}")
             prev = core.header_hash(header)
         return None
 
@@ -293,7 +318,7 @@ class SimNode:
                      "bounds before adoption").inc()
 
     def _adopt(self, anchor: int, suffix: list[bytes],
-               own_height: int) -> int:
+               own_height: int, peer=None) -> int:
         old = [self.node.block_hash(i)
                for i in range(anchor + 1, own_height + 1)]
         old_tip = self.node.tip_hash.hex()[:12]
@@ -303,7 +328,10 @@ class SimNode:
                              if self.node.find(d) < 0]
             rolled_back = len(rolled_hashes)
             adopted = self.node.height - own_height + rolled_back
+            # ``peer`` (who served the adopted suffix) lets the
+            # forensics flood audit prove chains-untouched non-vacuously.
             self.causal.record("adopt", step=self.sim_step,
+                               peer=peer,
                                old_tip=old_tip,
                                new_tip=self.node.tip_hash.hex()[:12],
                                height=self.node.height, anchor=anchor,
@@ -535,7 +563,7 @@ def run_adversarial(config: MinerConfig | None = None,
                     partition_steps: int = 30, target_height: int = 8,
                     nonce_budget: int = 1 << 8, delay_steps: int = 1,
                     drop_rate_pct: int = 0, seed: int = 0,
-                    n_groups: int = 2,
+                    n_groups: int = 2, retarget=None,
                     on_network: Callable[["Network"], None] | None = None
                     ) -> Network:
     """BASELINE config 5: competing miner groups, then reconciliation.
@@ -544,12 +572,14 @@ def run_adversarial(config: MinerConfig | None = None,
     different payloads), the partition heals, and longest-chain reorg
     resolution must converge every node onto one chain — optionally under
     delivery delay and seeded random message loss on top of the partition.
+    ``retarget`` (a ``sim.retarget.RetargetRule``) arms every group's
+    chain with the height-scheduled difficulty rule.
     """
     if n_groups < 2:
         raise ConfigError(f"n_groups must be >= 2, got {n_groups}")
     cfg = config if config is not None else MinerConfig(
         difficulty_bits=8, n_blocks=target_height, backend="cpu")
-    nodes = [SimNode(i, cfg) for i in range(n_groups)]
+    nodes = [SimNode(i, cfg, retarget=retarget) for i in range(n_groups)]
     net = Network(nodes, delay_steps=delay_steps,
                   drop_fn=(seeded_drop(drop_rate_pct, seed)
                            if drop_rate_pct else None),

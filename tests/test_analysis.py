@@ -450,3 +450,103 @@ def test_res001_cli_pass_family(tmp_path):
         cwd=ROOT, capture_output=True, text=True, timeout=600)
     assert proc.returncode == 1, proc.stdout + proc.stderr
     assert "RES001" in proc.stdout
+
+
+# ---- RES002: seeded-RNG-only adversary/scenario paths ------------------
+
+
+BAD_ADVERSARY = textwrap.dedent("""\
+    import random
+    import numpy as np
+    from numpy.random import default_rng
+
+    def attack(step, eng):
+        jitter = random.random()           # RES002 via the import
+        import time
+        when = time.time()                 # RES002: wall clock
+        np.random.seed(step)               # RES002: stateful global RNG
+        g = np.random.default_rng()        # RES002: unseeded (OS entropy)
+        h = default_rng()                  # RES002: bare unseeded call
+        return jitter, when, g, h
+    """)
+
+OK_ADVERSARY = textwrap.dedent("""\
+    import hashlib
+
+    import numpy as np
+
+    def attack(step, eng):
+        u = eng.rng.vector("adversary", step, 0, 8)   # seeded ScenarioRng
+        g = np.random.Generator(np.random.Philox(key=np.array(
+            [1, 2], dtype=np.uint64)))                # keyed: allowed
+        ok = np.random.default_rng(42)                # seeded: allowed
+        key = hashlib.sha256(b"x").hexdigest()        # hashing: allowed
+        return u, g, ok, key
+    """)
+
+
+def test_res002_nondeterminism_fires(tmp_path):
+    from mpi_blockchain_tpu.analysis.resilience_lint import (
+        run_resilience_lint)
+
+    bad = tmp_path / "bad_strategy.py"
+    bad.write_text(BAD_ADVERSARY)
+    findings = run_resilience_lint(ROOT,
+                                   overrides={"resilience_files": [],
+                                              "adversary_files": [bad]})
+    assert rule_set(findings) == {"RES002"}
+    # import random, time.time, np.random.seed, unseeded default_rng
+    # (dotted AND bare from-import forms; the `import time` inside the
+    # function is a stdlib module import, not banned — only its
+    # wall-clock CALLS are).
+    assert len(findings) == 5, "\n".join(f.render() for f in findings)
+
+
+def test_res002_seeded_patterns_pass(tmp_path):
+    from mpi_blockchain_tpu.analysis.resilience_lint import (
+        run_resilience_lint)
+
+    ok = tmp_path / "ok_strategy.py"
+    ok.write_text(OK_ADVERSARY)
+    assert run_resilience_lint(
+        ROOT, overrides={"resilience_files": [],
+                         "adversary_files": [ok]}) == []
+
+
+def test_res002_inline_suppression(tmp_path):
+    suppressed = BAD_ADVERSARY.replace(
+        "    jitter = random.random()",
+        "    jitter = random.random()  # chainlint: disable=RES002"
+    ).replace(
+        "import random",
+        "import random  # chainlint: disable=RES002")
+    bad = tmp_path / "bad_strategy.py"
+    bad.write_text(suppressed)
+    findings = run_all(root=tmp_path, passes=["resilience"],
+                       overrides={"resilience_files": [],
+                                  "adversary_files": [bad]})
+    assert len([f for f in findings if f.rule == "RES002"]) == 4
+
+
+def test_res002_live_sim_tree_clean():
+    """The shipping adversary/scenario package obeys its own rule: every
+    draw goes through the seeded ScenarioRng."""
+    from mpi_blockchain_tpu.analysis.resilience_lint import (
+        _adversary_files, run_resilience_lint)
+
+    assert _adversary_files(ROOT), "sim/ package not found by the lint"
+    findings = [f for f in run_resilience_lint(ROOT)
+                if f.rule == "RES002"]
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_res002_cli_pass_family(tmp_path):
+    bad = tmp_path / "bad_strategy.py"
+    bad.write_text(BAD_ADVERSARY)
+    proc = subprocess.run(
+        [sys.executable, "-m", "mpi_blockchain_tpu.analysis",
+         "--passes", "resilience", "--override",
+         f"adversary_files={bad}"],
+        cwd=ROOT, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "RES002" in proc.stdout

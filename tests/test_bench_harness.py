@@ -101,6 +101,13 @@ def _run_main(monkeypatch, capsys, dev_sections, dev_err=None,
                         lambda: (dev_sections, dev_err))
     monkeypatch.setattr(bench, "_run_sharded_section",
                         lambda: (sharded, sharded_err))
+    monkeypatch.setattr(
+        bench, "_run_sim_adversarial_section",
+        lambda: ({"preset": "adversarial-bench", "n_nodes": 200,
+                  "steps": 1500, "steps_per_sec": 1200.0, "wall_s": 1.25,
+                  "converged": True, "blocks_total": 400,
+                  "final_bits": 16, "sync_rejections": 30, "reorgs": 5000,
+                  "reps": 2, "spread_pct": 2.0}, None))
     roofline_calls = []
     monkeypatch.setattr(bench, "_run_roofline_section",
                         lambda mhs: (roofline_calls.append(mhs),
@@ -133,7 +140,9 @@ def test_main_fresh_device_record(tmp_cache, monkeypatch, capsys):
     # history (the sentinel's trajectory accumulates with no manual step)
     from mpi_blockchain_tpu.perfwatch.history import HistoryStore
     recorded = {e.section for e in HistoryStore(bench.HISTORY_PATH).entries()}
-    assert {"cpu_np8", "sweep", "chain"} <= recorded
+    assert {"cpu_np8", "sweep", "chain", "sim_adversarial"} <= recorded
+    # ... and the adversarial-sim section rode along in the report.
+    assert rec["detail"]["sim_adversarial"]["steps_per_sec"] == 1200.0
 
 
 def test_main_no_record_opts_out(tmp_cache, monkeypatch, capsys):

@@ -124,3 +124,38 @@ def test_ctypes_binding_passes_chain_suite():
         timeout=300)
     assert proc.returncode == 0, proc.stdout[-1500:] + proc.stderr[-500:]
     assert " passed" in proc.stdout   # rc 0 already proves zero failures
+
+
+_RETARGET_SNIPPET = """
+from mpi_blockchain_tpu import core
+assert core.BINDING == {binding!r}, core.BINDING
+n = core.Node(8, 0)
+assert n.set_retarget(2, 1, 12)
+for h in range(1, 5):
+    cand = n.make_candidate(b"retarget:%d" % h)
+    bits = core.HeaderFields.unpack(cand).bits
+    assert bits == n.next_bits() == min(8 + h // 2, 12), (h, bits)
+    nonce, _ = core.cpu_search(cand, 0, 1 << 32, bits)
+    assert n.submit(core.set_nonce(cand, nonce))
+assert not n.set_retarget(3, 1, 12)   # frozen with history
+m = core.Node(8, 1)
+assert m.set_retarget(2, 1, 12) and m.load(n.save())
+assert not core.Node(8, 2).load(n.save())   # unarmed peer rejects
+print("TIP:" + n.tip_hash.hex())
+"""
+
+
+def test_bindings_retarget_identical_chains():
+    """The retarget surface (set_retarget/next_bits + schedule-aware
+    candidates, adoption, save/load) behaves identically through both
+    bindings — byte-identical retargeted tips."""
+    def tip(binding):
+        env = dict(os.environ, MBT_BINDING=binding, PYTHONPATH=str(REPO))
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             _RETARGET_SNIPPET.format(binding=binding)],
+            env=env, capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 0, proc.stderr[-800:]
+        return [ln for ln in proc.stdout.splitlines()
+                if ln.startswith("TIP:")][0]
+    assert tip("pybind11") == tip("ctypes")

@@ -1,0 +1,853 @@
+"""Adversarial network-scale hardening (ISSUE 6): retargeting, scenario
+composition, the vectorized engine, live attack strategies, and the
+byzantine-bounds regression tests driven by real attackers."""
+import json
+
+import numpy as np
+import pytest
+
+from mpi_blockchain_tpu import core
+from mpi_blockchain_tpu.config import ConfigError, MinerConfig
+from mpi_blockchain_tpu.sim import (SCENARIO_PRESETS, AdversarySpec,
+                                    ChurnEvent, ChurnSchedule, LatencySpec,
+                                    PartitionWindow, RetargetRule, Scenario,
+                                    ScenarioRng, run_scenario)
+from mpi_blockchain_tpu.sim.real_attackers import (FloodingSimNode,
+                                                   eclipse_drop_fn)
+from mpi_blockchain_tpu.simulation import Network, SimNode, run_adversarial
+
+CFG = MinerConfig(difficulty_bits=8, n_blocks=6, backend="cpu")
+
+
+def _mine_one(node: SimNode) -> bytes:
+    hdr = None
+    while hdr is None:
+        hdr = node.mine_step(1 << 12)
+    return hdr
+
+
+# ---- difficulty retargeting: the rule + both validation paths -----------
+
+
+def test_retarget_rule_mirrors_cpp_schedule():
+    """The Python RetargetRule and the C++ Chain::expected_bits are the
+    SAME closed form — pinned by walking a live chain through two
+    boundaries and comparing next_bits at every height."""
+    rule = RetargetRule(interval=3, step_bits=2, max_bits=14)
+    node = core.Node(8, 0)
+    rule.apply(node)
+    for h in range(1, 8):
+        assert node.next_bits() == rule.expected_bits(8, h)
+        cand = node.make_candidate(b"b%d" % h)
+        bits = core.HeaderFields.unpack(cand).bits
+        assert bits == rule.expected_bits(8, h)
+        nonce, _ = core.cpu_search(cand, 0, 1 << 22, bits)
+        assert nonce is not None
+        assert node.submit(core.set_nonce(cand, nonce))
+    # Clamped at max_bits: height 9+ would be 8 + 2*3 = 14 == max.
+    assert rule.expected_bits(8, 9) == 14
+    assert rule.expected_bits(8, 900) == 14
+
+
+def test_retarget_validated_on_adoption_not_just_locally():
+    """A node WITHOUT the rule must reject a retargeted chain on the
+    adoption path (wrong bits at the boundary heights), and an armed
+    node must round-trip its own save."""
+    rule = RetargetRule(interval=2, step_bits=1, max_bits=12)
+    a = core.Node(8, 0)
+    rule.apply(a)
+    for h in range(1, 5):
+        cand = a.make_candidate(b"x%d" % h)
+        bits = core.HeaderFields.unpack(cand).bits
+        nonce, _ = core.cpu_search(cand, 0, 1 << 22, bits)
+        assert a.submit(core.set_nonce(cand, nonce))
+    blob = a.save()
+    armed = core.Node(8, 1)
+    rule.apply(armed)
+    assert armed.load(blob) and armed.tip_hash == a.tip_hash
+    assert not core.Node(8, 2).load(blob), \
+        "unarmed node adopted a retargeted chain"
+    # adopt_suffix path: wrong-bits suffix is INVALID, chain untouched.
+    b = core.Node(8, 3)
+    rule.apply(b)
+    headers = a.all_headers()
+    assert b.adopt_suffix(0, headers) == core.RecvResult.REORGED
+    plain = core.Node(8, 4)
+    assert plain.adopt_suffix(0, headers) == core.RecvResult.INVALID
+    assert plain.height == 0
+
+
+def test_set_retarget_frozen_once_history_exists():
+    node = core.Node(8, 0)
+    cand = node.make_candidate(b"one")
+    nonce, _ = core.cpu_search(cand, 0, 1 << 22, 8)
+    assert node.submit(core.set_nonce(cand, nonce))
+    assert not node.set_retarget(4, 1, 12)
+    assert node.next_bits() == 8
+
+
+def test_simnode_sync_rejects_retarget_bits_mismatch():
+    """The SimNode pre-check gives schedule violations their own
+    sync_rejected reason: a linkage-valid suffix whose bits ignore the
+    schedule must be rejected with 'retarget' before any C++ work."""
+    rule = RetargetRule(interval=1, step_bits=1, max_bits=12)
+    victim = SimNode(0, CFG, retarget=rule)
+    # Forge a linkage-valid suffix from genesis with WRONG (constant)
+    # bits: heights 1..3 under interval=1 demand 9, 10, 11.
+    prev = victim.node.block_hash(0)
+    forged = []
+    for h in range(1, 4):
+        hdr = core.HeaderFields(
+            version=1, prev_hash=prev,
+            data_hash=core.sha256d(b"forged%d" % h),
+            timestamp=h, bits=8, nonce=0).pack()
+        forged.append(hdr)
+        prev = core.header_hash(hdr)
+    import types
+
+    from mpi_blockchain_tpu.telemetry import CausalLog
+    evil = types.SimpleNamespace(
+        id=66, sim_step=0, causal=CausalLog(66),
+        find_anchor=lambda locator: 0,
+        node=types.SimpleNamespace(headers_from=lambda h: list(forged),
+                                   all_headers=lambda: list(forged)))
+    tip = victim.node.tip_hash
+    victim._sync_from(evil)
+    assert victim.node.tip_hash == tip
+    rej = [e for e in victim.causal.events()
+           if e["kind"] == "sync_rejected"]
+    assert rej and "retarget" in rej[-1]["reason"]
+
+
+def test_retargeted_adversarial_run_converges_on_scheduled_bits():
+    rule = RetargetRule(interval=3, step_bits=1, max_bits=10)
+    net = run_adversarial(partition_steps=12, target_height=7,
+                          retarget=rule)
+    assert net.converged()
+    for n in net.nodes:
+        for h in range(1, n.node.height + 1):
+            f = core.HeaderFields.unpack(n.node.block_header(h))
+            assert f.bits == rule.expected_bits(8, h), (h, f.bits)
+
+
+def test_retarget_parse():
+    assert RetargetRule.parse("2000:1:20") == RetargetRule(2000, 1, 20)
+    assert RetargetRule.parse("50") == RetargetRule(50, 1, 0)
+    with pytest.raises(ConfigError):
+        RetargetRule.parse("a:b")
+    with pytest.raises(ConfigError):
+        RetargetRule.parse("1:2:3:4")
+
+
+# ---- scenario objects: seeded composition precedence --------------------
+
+
+def _composed_scenario(**kw):
+    defaults = dict(
+        n_nodes=8, steps=100, seed=5, difficulty_bits=10,
+        drop_rate_pct=100,
+        partitions=(PartitionWindow(start=10, until=20, groups=2),),
+        churn=ChurnSchedule(events=(
+            ChurnEvent(step=10, node=7, kind="crash", down_steps=15),)),
+    )
+    defaults.update(kw)
+    return Scenario(**defaults)
+
+
+def test_fault_composition_precedence_churn_partition_drop():
+    """The documented verdict order: churn (lost) > partition (defer)
+    > drop (lost), one seed, evaluated at the delivery step."""
+    sc = _composed_scenario()
+    down = {7}
+    alive = lambda n: n not in down                     # noqa: E731
+    # node 7 is down at step 12: churn wins over both the active
+    # partition (7 is in group 1, sender 0 in group 0) and the 100%
+    # drop schedule.
+    assert sc.blocked(12, 0, 7, alive=alive) == "churn"
+    # cross-partition, both alive: partition wins over the 100% drop.
+    assert sc.blocked(12, 0, 5, alive=alive) == "partition"
+    # same group, both alive: the drop schedule decides.
+    assert sc.blocked(12, 0, 1, alive=alive) == "drop"
+    # outside the window, same pair: drop again (partition inactive).
+    assert sc.blocked(30, 0, 5, alive=alive) == "drop"
+    # no faults at all: delivered.
+    quiet = _composed_scenario(drop_rate_pct=0, partitions=(),
+                               churn=ChurnSchedule())
+    assert quiet.blocked(12, 0, 5, alive=lambda n: True) is None
+
+
+def test_composition_is_deterministic_and_churn_independent():
+    """Adding churn must not perturb the drop schedule's draws for
+    unrelated (step, sender, receiver) triples — every draw is keyed by
+    the seed, not by evaluation order."""
+    sc30 = _composed_scenario(drop_rate_pct=30)
+    verdicts = [(s, a, b, sc30.blocked(s, a, b))
+                for s in range(30, 60) for a in range(3)
+                for b in range(3) if a != b]
+    no_churn = _composed_scenario(drop_rate_pct=30,
+                                  churn=ChurnSchedule())
+    assert verdicts == [(s, a, b, no_churn.blocked(s, a, b))
+                       for s in range(30, 60) for a in range(3)
+                       for b in range(3) if a != b]
+    # And the legacy adapter agrees: drops where blocked says lost.
+    fn = sc30.drop_fn()
+    for (s, a, b, v) in verdicts:
+        assert fn(s, a, b) == (v in ("churn", "drop"))
+
+
+def test_scenario_rng_vectors_are_independent_across_steps():
+    """Regression for the Philox counter-overlap bug: consecutive steps
+    must yield unrelated vectors (the counter is the intra-stream block
+    index — identity lives in the KEY)."""
+    rng = ScenarioRng(0)
+    a = rng.vector("mine", 9999, 0, 1000)
+    b = rng.vector("mine", 10000, 0, 1000)
+    assert not np.array_equal(a, b)
+    # No sliding-window overlap either (the original failure mode).
+    assert not np.isin(a, b).any()
+    # Deterministic per key.
+    assert np.array_equal(a, ScenarioRng(0).vector("mine", 9999, 0, 1000))
+    # Tag and seed both separate streams.
+    assert not np.array_equal(a, ScenarioRng(1).vector("mine", 9999, 0,
+                                                       1000))
+    assert not np.array_equal(a, rng.vector("drop", 9999, 0, 1000))
+
+
+def test_churn_schedule_from_seed_deterministic():
+    a = ChurnSchedule.from_seed(3, n_nodes=50, steps=400, n_events=6)
+    assert a == ChurnSchedule.from_seed(3, n_nodes=50, steps=400,
+                                        n_events=6)
+    assert a != ChurnSchedule.from_seed(4, n_nodes=50, steps=400,
+                                        n_events=6)
+    by_step = a.by_step(400)
+    # Every crash expands into a later join (restart) within range.
+    crashes = [e for e in a.events if e.kind == "crash"]
+    assert crashes
+    for e in crashes:
+        if e.step + e.down_steps < 400:
+            assert any(j.kind == "join" and j.node == e.node
+                       for j in by_step.get(e.step + e.down_steps, []))
+
+
+def test_adversary_spec_parse():
+    s = AdversarySpec.parse("selfish:node=1,hashrate=8")
+    assert s.kind == "selfish" and s.node == 1 and s.hashrate == 8
+    e = AdversarySpec.parse("eclipse:node=2,victim=5,start=50,until=120")
+    assert e.victim == 5 and e.until == 120
+    with pytest.raises(ConfigError):
+        AdversarySpec.parse("eclipse:node=2")       # victim required
+    with pytest.raises(ConfigError):
+        AdversarySpec.parse("ddos:node=1")
+    with pytest.raises(ConfigError):
+        AdversarySpec.parse("flood:node")
+
+
+def test_latency_spec_draws_bounded_and_seeded():
+    spec = LatencySpec("uniform", 1, 3)
+    rng = ScenarioRng(9)
+    d = spec.delays(rng, 5, 0, 500)
+    assert d.min() >= 1 and d.max() <= 3
+    assert np.array_equal(d, spec.delays(ScenarioRng(9), 5, 0, 500))
+    assert LatencySpec.parse("2") == LatencySpec("fixed", 2, 2)
+    assert LatencySpec.parse("1-3") == LatencySpec("uniform", 1, 3)
+
+
+# ---- the vectorized engine ----------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def smoke_run():
+    net, summary = run_scenario(SCENARIO_PRESETS["adversarial-smoke"])
+    return net, summary
+
+
+def test_vec_smoke_converges_with_all_machinery(smoke_run):
+    net, s = smoke_run
+    assert s["converged"]
+    assert s["blocks_total"] > 0 and s["canonical_height"] > 0
+    # Retargeting really crossed a boundary inside the horizon.
+    assert s["final_bits"] > net.scenario.difficulty_bits
+    # Churn fired.
+    churn = [e for e in net.bus_log.events() if e["kind"] == "churn"]
+    assert churn
+    # All three strategies were live.
+    assert s["strategies"]["selfish"]["withheld_total"] > 0
+    assert s["strategies"]["eclipse"]["blocked_total"] > 0
+    assert s["strategies"]["flood"]["attacks"] > 0
+
+
+def test_vec_byte_identical_dumps_same_seed(tmp_path):
+    sc = SCENARIO_PRESETS["adversarial-smoke"]
+    n1, s1 = run_scenario(sc)
+    n2, s2 = run_scenario(sc)
+    assert s1 == s2
+    a, b = tmp_path / "a.json", tmp_path / "b.json"
+    n1.dump_causal(a)
+    n2.dump_causal(b)
+    assert a.read_bytes() == b.read_bytes()
+
+
+def test_vec_flood_exercises_all_rejection_paths(smoke_run):
+    net, s = smoke_run
+    flood = s["strategies"]["flood"]
+    assert flood["attacks"] == s["sync_rejections"]
+    assert set(flood["rejected_by_mode"]) == {"budget", "linkage", "bits"}
+    assert all(v > 0 for v in flood["rejected_by_mode"].values())
+
+
+def test_vec_eclipse_victim_recovers(smoke_run):
+    net, s = smoke_run
+    ecl = s["strategies"]["eclipse"]
+    assert ecl["victim_converged"]
+    victim = ecl["victim"]
+    # The victim healed through a real adopt after the window closed.
+    adopts = [e for e in net.log(victim).events() if e["kind"] == "adopt"
+              and e["step"] >= net.scenario.adversaries[1].until]
+    assert adopts
+
+
+def test_vec_selfish_withhold_release_causes_reorgs(smoke_run):
+    net, s = smoke_run
+    selfish = s["strategies"]["selfish"]
+    assert selfish["withheld_total"] > 0
+    assert selfish["released_total"] > 0
+    releases = [e for e in net.log(selfish["node"]).events()
+                if e["kind"] == "attack_release"]
+    assert len(releases) == selfish["releases"]
+
+
+def test_vec_stats_heights_consistent(smoke_run):
+    net, s = smoke_run
+    live = net.alive
+    assert s["height_min"] == s["height_max"] == s["canonical_height"]
+    # Every live tip's stored height matches its block's height.
+    for i in np.nonzero(live)[0]:
+        assert net.blocks[int(net.tips[i])].height == net.heights[i]
+
+
+def test_vec_forensics_attack_audit(smoke_run, tmp_path):
+    from mpi_blockchain_tpu.forensics import analyze_dump, load_causal_dump
+    net, s = smoke_run
+    path = tmp_path / "dump.json"
+    net.dump_causal(path)
+    report = analyze_dump(load_causal_dump(path))
+    audit = report["attack_audit"]
+    selfish = audit["selfish"][0]
+    assert selfish["withheld_total"] > 0
+    assert any(r["reorgs_caused"] for r in selfish["releases"])
+    eclipse = audit["eclipse"][0]
+    assert eclipse["victim_tip_canonical"]
+    assert eclipse["post_heal_adopt"] is not None
+    flood = audit["flood"][0]
+    assert flood["rejections"] > 0 and flood["chains_untouched"]
+    assert set(flood["rejections_by_path"]) == {"budget", "linkage",
+                                                "bits"}
+    # The report itself is deterministic.
+    assert report == analyze_dump(load_causal_dump(path))
+
+
+def test_vec_partition_defers_not_drops():
+    sc = Scenario(n_nodes=6, steps=60, seed=2, difficulty_bits=8,
+                  hashes_per_step=16,
+                  partitions=(PartitionWindow(start=5, until=30,
+                                              groups=2),),
+                  record_deliveries=True, converge_margin=100)
+    net, s = run_scenario(sc)
+    assert s["converged"]
+    defers = [e for e in net.bus_log.events() if e["kind"] == "defer"]
+    assert defers, "partition produced no deferrals"
+    assert all(e["until_step"] == 30 for e in defers)
+
+
+def test_vec_sync_group_validates_budget():
+    """An honest heal whose suffix exceeds the budget is refused —
+    the byzantine bound applies to every adoption, not just attacks."""
+    sc = Scenario(n_nodes=4, steps=80, seed=3, difficulty_bits=6,
+                  hashes_per_step=16, max_sync_suffix=2,
+                  partitions=(PartitionWindow(start=1, until=60,
+                                              groups=2),),
+                  record_deliveries=True, converge_margin=0)
+    net, s = run_scenario(sc)
+    # With a 2-header budget and a 59-step partition, the heal suffixes
+    # overflow the budget: rejections observed, groups stay forked.
+    assert s["sync_rejections"] > 0
+
+
+def test_vec_crash_restart_node_rejoins_and_heals():
+    sc = Scenario(n_nodes=6, steps=120, seed=4, difficulty_bits=8,
+                  hashes_per_step=16,
+                  churn=ChurnSchedule(events=(
+                      ChurnEvent(step=20, node=5, kind="crash",
+                                 down_steps=40),)),
+                  record_deliveries=True, converge_margin=200)
+    net, s = run_scenario(sc)
+    assert s["converged"]
+    churn = [e for e in net.bus_log.events() if e["kind"] == "churn"]
+    assert [(e["action"], e["node"]) for e in churn] == \
+        [("crash", 5), ("join", 5)]
+    assert bool(net.alive[5])
+    assert net.tips[5] == net.canonical_tip().idx
+
+
+# ---- byzantine bounds driven by real attackers on the live bus ----------
+
+
+def _live_bus(flood_mode: str, seed: int):
+    honest = [SimNode(i, CFG) for i in range(2)]
+    flooder = FloodingSimNode(2, CFG, mode=flood_mode, seed=seed)
+    net = Network(honest + [flooder])
+    for _ in range(40):
+        net.step(nonce_budget=1 << 8)
+    return net, honest, flooder
+
+
+def test_flood_budget_rejected_on_live_bus():
+    net, honest, flooder = _live_bus("budget", seed=1)
+    tips = [n.node.tip_hash for n in honest]
+    flooder.flood(net)
+    net.step(nonce_budget=1 << 8)
+    for n, tip in zip(honest, tips):
+        rej = [e for e in n.causal.events()
+               if e["kind"] == "sync_rejected"]
+        assert rej and "budget" in rej[-1]["reason"]
+        assert n.node.find(tip) >= 0, "flood rolled back a block"
+    assert flooder.floods == 1
+
+
+def test_flood_linkage_rejected_on_live_bus():
+    net, honest, flooder = _live_bus("linkage", seed=2)
+    flooder.flood(net)
+    net.step(nonce_budget=1 << 8)
+    for n in honest:
+        rej = [e for e in n.causal.events()
+               if e["kind"] == "sync_rejected"]
+        assert rej and "linkage" in rej[-1]["reason"]
+    # And the bus still converges afterwards despite the flooder: its
+    # real inner chain follows the honest tip through appends.
+    net.run(target_height=6, nonce_budget=1 << 8)
+    assert net.converged()
+
+
+def test_flood_increments_shared_counter():
+    from mpi_blockchain_tpu.telemetry import counter
+    before = counter("sim_sync_rejected_total").value
+    net, honest, flooder = _live_bus("budget", seed=3)
+    flooder.flood(net)
+    net.step(nonce_budget=1 << 8)
+    assert counter("sim_sync_rejected_total").value >= before + 2
+
+
+def test_eclipsed_node_recovers_after_heal_on_live_bus():
+    """Satellite 2: an eclipsed node forks in isolation and must heal
+    via the normal longest-chain sync when the monopolization lifts."""
+    nodes = [SimNode(i, CFG) for i in range(3)]
+    net = Network(nodes, drop_fn=eclipse_drop_fn(victim=2, attacker=1,
+                                                 start=0, until=25))
+    net.run(target_height=6, nonce_budget=1 << 8)
+    assert net.converged()
+    victim = nodes[2]
+    # The victim's chain is the group chain now, and it got there by
+    # adopting (it mined alone during the eclipse).
+    assert victim.node.tip_hash == nodes[0].node.tip_hash
+    assert victim.stats.blocks_mined > 0
+    assert victim.stats.blocks_adopted > 0
+    for n in nodes:
+        assert n.stats.conserved_height() == n.node.height
+
+
+# ---- bench + perfwatch gating -------------------------------------------
+
+
+def test_bench_sim_adversarial_payload():
+    from mpi_blockchain_tpu.bench_lib import bench_sim_adversarial
+    p = bench_sim_adversarial()
+    assert p["steps_per_sec"] > 0 and p["wall_s"] > 0
+    assert p["converged"] is True
+    assert p["n_nodes"] == 200 and p["steps"] == 1500
+    assert p["sync_rejections"] > 0
+
+
+def test_perfwatch_gates_sim_adversarial(tmp_path):
+    from mpi_blockchain_tpu.perfwatch.detector import (SECTION_FLOOR_PCT,
+                                                       check_candidate)
+    from mpi_blockchain_tpu.perfwatch.history import (SECTION_METRICS,
+                                                      HistoryStore)
+    assert SECTION_METRICS["sim_adversarial"] == ("steps_per_sec",
+                                                  "higher")
+    # The CPU-load floor mirrors the cpu_np8 precedent.
+    assert SECTION_FLOOR_PCT["sim_adversarial"] == 60.0
+    store = HistoryStore(tmp_path / "h.jsonl")
+    base = {"preset": "adversarial-bench", "steps_per_sec": 1000.0,
+            "spread_pct": 3.0}
+    store.record("sim_adversarial", base)
+    ok = check_candidate(store, "sim_adversarial",
+                         {**base, "steps_per_sec": 500.0})
+    assert ok.verdict == "ok", "within the 60% CPU-load floor"
+    bad = check_candidate(store, "sim_adversarial",
+                          {**base, "steps_per_sec": 300.0})
+    assert bad.verdict == "regression"
+
+
+def test_repo_history_has_sim_adversarial_series():
+    import pathlib
+
+    from mpi_blockchain_tpu.perfwatch.detector import check_history
+    from mpi_blockchain_tpu.perfwatch.history import HistoryStore
+    store = HistoryStore(pathlib.Path(__file__).resolve().parent.parent
+                         / "PERF_HISTORY.jsonl")
+    entries = store.entries("sim_adversarial")
+    assert entries, "PERF_HISTORY.jsonl lacks the sim_adversarial seed"
+    findings = [f for f in check_history(store)
+                if f.section == "sim_adversarial"]
+    assert findings and all(f.verdict != "regression" for f in findings)
+
+
+# ---- CLI ----------------------------------------------------------------
+
+
+def test_cli_sim_scenario_preset(capsys):
+    from mpi_blockchain_tpu.cli import main
+    rc = main(["sim", "--preset", "adversarial-smoke"])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0
+    assert out["engine"] == "vec" and out["converged"]
+    assert out["steps_per_sec"] > 0
+
+
+def test_cli_sim_adhoc_vec_flags(capsys, tmp_path):
+    from mpi_blockchain_tpu.cli import main
+    dump = tmp_path / "ev.json"
+    rc = main(["sim", "--nodes", "12", "--steps", "120", "--seed", "3",
+               "--difficulty", "10", "--latency", "1-2",
+               "--retarget", "40:1:12", "--churn", "2",
+               "--strategy", "flood:node=1,every=20",
+               "--strategy", "selfish:node=2,hashrate=6",
+               "--events-dump", str(dump)])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0 and out["engine"] == "vec"
+    assert out["sync_rejections"] > 0
+    assert out["strategies"]["selfish"]["withheld_total"] >= 0
+    assert dump.exists()
+    payload = json.loads(dump.read_text())
+    assert payload["meta"]["scenario"]["retarget"]["interval"] == 40
+
+
+def test_cli_legacy_sim_retarget(capsys):
+    from mpi_blockchain_tpu.cli import main
+    rc = main(["sim", "--blocks", "5", "--partition-steps", "10",
+               "--retarget", "3:1:10"])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0 and out["converged"]
+
+
+def test_cli_sim_bad_strategy_is_config_error(capsys):
+    from mpi_blockchain_tpu.cli import main
+    rc = main(["sim", "--nodes", "8", "--steps", "50",
+               "--strategy", "nonsense:node=1"])
+    assert rc == 2
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["event"] == "error"
+
+
+# ---- the 1000-node, 10k-step headline (slow; outside tier-1) ------------
+
+
+@pytest.mark.slow
+def test_adversarial_1k_preset_byte_identical_and_converged(tmp_path):
+    """ISSUE 6 acceptance: the 1000-node 10k-step preset completes with
+    churn, retargeting, and all three attack strategies live, converges
+    in the fault-free margin, and two same-seed runs produce
+    byte-identical causal dumps."""
+    sc = SCENARIO_PRESETS["adversarial-1k"]
+    assert sc.n_nodes == 1000 and sc.steps == 10_000
+    n1, s1 = run_scenario(sc)
+    assert s1["converged"]
+    assert s1["final_bits"] > sc.difficulty_bits       # retargeted
+    churn = [e for e in n1.bus_log.events() if e["kind"] == "churn"]
+    assert churn                                       # churned
+    active = [k for k, v in s1["strategies"].items()
+              if (v.get("withheld_total") or v.get("blocked_total")
+                  or v.get("attacks"))]
+    assert len(active) >= 2, f"need >=2 live strategies, got {active}"
+    assert s1["sync_rejections"] > 0
+    a = tmp_path / "a.json"
+    b = tmp_path / "b.json"
+    n1.dump_causal(a)
+    n2, s2 = run_scenario(sc)
+    assert s2 == s1
+    n2.dump_causal(b)
+    assert a.read_bytes() == b.read_bytes()
+
+
+def test_fault_plan_sim_churn_site_crashes_nodes():
+    """PR 5 fault-plan machinery composes with the vec engine: an armed
+    plan's sim.churn site crash-restarts seeded-chosen nodes, recorded
+    causally, and the run stays byte-reproducible under the fixed plan."""
+    from mpi_blockchain_tpu.resilience import injection
+    from mpi_blockchain_tpu.resilience.faultplan import FaultPlan
+
+    plan = FaultPlan.from_dict({"version": 1, "seed": 1, "faults": [
+        {"site": "sim.churn", "kind": "partial", "call": 10, "times": 2},
+    ]})
+    sc = Scenario(n_nodes=8, steps=80, seed=6, difficulty_bits=8,
+                  hashes_per_step=16, record_deliveries=True,
+                  converge_margin=200)
+
+    def churned():
+        injection.arm(plan)
+        try:
+            net, s = run_scenario(sc)
+        finally:
+            injection.disarm()
+        return net, s
+
+    net, s = churned()
+    injected = [e for e in net.bus_log.events()
+                if e["kind"] == "churn" and e.get("injected")]
+    assert len(injected) == 2 and all(e["action"] == "crash"
+                                      for e in injected)
+    assert s["converged"]
+    # Same plan + same scenario => byte-identical causal story.
+    net2, s2 = churned()
+    assert s2 == s
+    assert [e for e in net2.bus_log.events()] == \
+        [e for e in net.bus_log.events()]
+    # Unarmed, the site costs nothing and no churn happens.
+    net3, s3 = run_scenario(sc)
+    assert not [e for e in net3.bus_log.events() if e["kind"] == "churn"]
+
+
+def test_zero_latency_delivers_next_step():
+    """Review regression: delay-0 announcements must land on the next
+    step's deliver (like the legacy bus), not strand in an
+    already-popped bucket until the drain replays them out-of-band."""
+    sc = Scenario(n_nodes=6, steps=80, seed=1, difficulty_bits=8,
+                  hashes_per_step=16, latency=LatencySpec("fixed", 0, 0),
+                  record_deliveries=True, converge_margin=50)
+    net, s = run_scenario(sc)
+    assert s["converged"]
+    assert s["deliveries"] > 0
+    # Deliveries happened DURING the horizon, not only in the drain.
+    deliver_steps = [e["step"] for lg in net.causal_logs()
+                     for e in lg.events() if e["kind"] == "deliver"]
+    assert deliver_steps and min(deliver_steps) < sc.steps // 2
+
+
+def test_adopt_events_name_their_peer(smoke_run):
+    """Review regression: the flood audit's chains-untouched invariant
+    needs adopts to say WHO was adopted from — both engines record it."""
+    net, s = smoke_run
+    adopts = [e for lg in net.causal_logs() for e in lg.events()
+              if e["kind"] == "adopt"]
+    assert adopts and all("peer" in e for e in adopts)
+    # Legacy bus too.
+    legacy = run_adversarial(partition_steps=15, target_height=5)
+    legacy_adopts = [e for n in legacy.nodes for e in n.causal.events()
+                     if e["kind"] == "adopt"]
+    assert legacy_adopts and all(e["peer"] is not None
+                                 for e in legacy_adopts)
+
+
+def test_eclipse_gauge_resets_for_open_ended_window():
+    """Review regression: an until=0 eclipse ends with the fault phase;
+    the gauge and the audit's end event must both say so."""
+    from mpi_blockchain_tpu.telemetry import gauge
+    sc = Scenario(n_nodes=8, steps=100, seed=2, difficulty_bits=8,
+                  hashes_per_step=16,
+                  adversaries=(AdversarySpec(kind="eclipse", node=1,
+                                             victim=4, start=10,
+                                             until=0),),
+                  record_deliveries=True, converge_margin=200)
+    net, s = run_scenario(sc)
+    assert s["converged"]
+    assert gauge("sim_eclipse_victims").value == 0
+    kinds = [e["kind"] for e in net.bus_log.events()]
+    assert "attack_eclipse_start" in kinds
+    assert "attack_eclipse_end" in kinds
+
+
+def test_cli_seed_zero_overrides_preset_seed(capsys, tmp_path):
+    """Review regression: an explicit --seed 0 must beat the preset's
+    baked-in seed (falsy-zero)."""
+    from mpi_blockchain_tpu.cli import main
+    a, b = tmp_path / "a.json", tmp_path / "b.json"
+    assert main(["sim", "--preset", "adversarial-smoke", "--seed", "0",
+                 "--events-dump", str(a)]) == 0
+    capsys.readouterr()
+    assert main(["sim", "--preset", "adversarial-smoke",
+                 "--events-dump", str(b)]) == 0
+    capsys.readouterr()
+    pa = json.loads(a.read_text())
+    pb = json.loads(b.read_text())
+    assert pa["meta"]["scenario"]["seed"] == 0
+    assert pb["meta"]["scenario"]["seed"] == 7     # the preset's own
+
+
+def test_cli_scenario_preset_names_in_sync():
+    """cli.SCENARIO_PRESET_NAMES is a numpy-free literal (building the
+    parser must not import the sim package); it must track the real
+    preset registry exactly."""
+    from mpi_blockchain_tpu.cli import SCENARIO_PRESET_NAMES
+    assert set(SCENARIO_PRESET_NAMES) == set(SCENARIO_PRESETS)
+
+
+def test_cli_import_stays_numpy_free():
+    import subprocess
+    import sys
+    code = ("import sys; import mpi_blockchain_tpu.cli as c; "
+            "c.main(['--help']) if False else None; "
+            "import argparse; "
+            "sys.exit(1 if 'numpy' in sys.modules else 0)")
+    proc = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, "importing cli pulled in numpy"
+
+
+def test_cli_engine_flag_crosstalk_is_config_error(capsys):
+    from mpi_blockchain_tpu.cli import main
+    # vec-only flags without the vec engine: loud, not silently ignored.
+    rc = main(["sim", "--strategy", "flood:node=1", "--blocks", "3"])
+    assert rc == 2
+    assert "vectorized engine" in json.loads(
+        capsys.readouterr().out.strip().splitlines()[-1])["error"]
+    # a legacy mining preset composed with --nodes: refused.
+    rc = main(["sim", "--preset", "cpu-single", "--nodes", "8"])
+    assert rc == 2
+    assert "legacy mining preset" in json.loads(
+        capsys.readouterr().out.strip().splitlines()[-1])["error"]
+
+
+def test_selfish_abandons_on_same_step_adopt_and_remine():
+    """Review regression: if the engine adopts the public chain and the
+    attacker re-mines in the SAME step, the stale private fork must be
+    abandoned (not silently kept and later re-broadcast as a release)."""
+    from mpi_blockchain_tpu.sim.strategies import SelfishMiner
+    sc = Scenario(n_nodes=4, steps=10, seed=0, difficulty_bits=8,
+                  hashes_per_step=16,
+                  adversaries=(AdversarySpec(kind="selfish", node=1,
+                                             hashrate=4),))
+    from mpi_blockchain_tpu.sim.vecnet import VecNetwork
+    eng = VecNetwork(sc)
+    strat = eng.strategies[0]
+    assert isinstance(strat, SelfishMiner)
+    # Attacker withholds A1 on genesis.
+    a1 = eng.new_block(0, 1, 1)
+    eng.tips[1] = a1.idx
+    eng.heights[1] = 1
+    assert strat.on_mined(eng, 1, 1, a1) is False
+    # Engine adopts a 2-long public chain over the attacker's tip
+    # (what _sync_group does), then the attacker immediately re-mines.
+    p1 = eng.new_block(0, 0, 1)
+    p2 = eng.new_block(p1.idx, 0, 2)
+    eng.tips[1] = p2.idx
+    eng.heights[1] = 2
+    c = eng.new_block(p2.idx, 1, 2)
+    eng.tips[1] = c.idx
+    eng.heights[1] = 3
+    assert strat.on_mined(eng, 2, 1, c) is False
+    # A1 was abandoned, not kept below C in the private chain.
+    assert strat.withheld == [c.idx]
+    assert strat.abandoned_total == 1
+    abandons = [e for e in eng.log(1).events()
+                if e["kind"] == "attack_abandon"]
+    assert abandons and abandons[-1]["count"] == 1
+
+
+def test_overlapping_eclipse_windows_sum_in_gauge():
+    """Review regression: two concurrent eclipses must read as 2 in
+    sim_eclipse_victims, and one ending must not zero the other."""
+    from mpi_blockchain_tpu.telemetry import gauge
+    sc = Scenario(n_nodes=10, steps=60, seed=3, difficulty_bits=8,
+                  hashes_per_step=16,
+                  adversaries=(
+                      AdversarySpec(kind="eclipse", node=1, victim=5,
+                                    start=5, until=40),
+                      AdversarySpec(kind="eclipse", node=2, victim=6,
+                                    start=10, until=50),
+                  ),
+                  record_deliveries=True, converge_margin=200)
+    from mpi_blockchain_tpu.sim.vecnet import VecNetwork
+    eng = VecNetwork(sc)
+    seen = {}
+    for _ in range(60):
+        eng.step()
+        seen[eng.step_count] = gauge("sim_eclipse_victims").value
+    assert seen[20] == 2        # both windows active
+    assert seen[45] == 1        # first ended, second still on
+    assert seen[55] == 0        # both over
+
+
+def test_cli_preset_honors_explicit_overrides(capsys, tmp_path):
+    """Review regression: flags passed WITH a scenario preset must
+    override it (never be silently dropped), and --nodes on a preset is
+    refused."""
+    from mpi_blockchain_tpu.cli import main
+    dump = tmp_path / "e.json"
+    rc = main(["sim", "--preset", "adversarial-smoke",
+               "--steps", "150", "--retarget", "30:1:11",
+               "--strategy", "flood:node=9,every=15",
+               "--events-dump", str(dump)])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0 and out["steps"] == 150
+    sc = json.loads(dump.read_text())["meta"]["scenario"]
+    assert sc["retarget"]["interval"] == 30
+    assert [a["kind"] for a in sc["adversaries"]] == ["flood"]
+    assert sc["adversaries"][0]["node"] == 9
+    rc = main(["sim", "--preset", "adversarial-smoke", "--nodes", "50"])
+    assert rc == 2
+    assert "cannot resize" in json.loads(
+        capsys.readouterr().out.strip().splitlines()[-1])["error"]
+
+
+def test_adversary_spec_validation_gaps_closed():
+    """Review regression: negative ids, victim==attacker, and inverted
+    windows are refused at construction."""
+    with pytest.raises(ConfigError):
+        AdversarySpec(kind="selfish", node=-2)
+    with pytest.raises(ConfigError):
+        AdversarySpec(kind="eclipse", node=2, victim=2)
+    with pytest.raises(ConfigError):
+        AdversarySpec(kind="eclipse", node=2, victim=5,
+                      start=260, until=180)
+
+
+def test_res002_catches_bare_from_imports(tmp_path):
+    from mpi_blockchain_tpu.analysis.resilience_lint import (
+        run_resilience_lint)
+    bad = tmp_path / "bare.py"
+    bad.write_text(
+        "from time import time, perf_counter\n"
+        "from os import urandom\n"
+        "def attack(step):\n"
+        "    return time(), perf_counter(), urandom(4)\n")
+    findings = run_resilience_lint(
+        tmp_path, overrides={"resilience_files": [],
+                             "adversary_files": [bad]})
+    assert len([f for f in findings if f.rule == "RES002"]) == 3, \
+        "\n".join(f.render() for f in findings)
+
+
+def test_release_audit_counts_descendant_adoptions(tmp_path):
+    """Review regression: a slow receiver that heals onto a DESCENDANT
+    of the released tip still credits the release's reorg count."""
+    from mpi_blockchain_tpu.forensics.attack_audit import attack_audit
+    merged = [
+        {"kind": "mine", "node": 1, "lamport": 1, "step": 1,
+         "hash": "aa1", "prev": "gen", "height": 1},
+        {"kind": "attack_withhold", "node": 1, "lamport": 2, "step": 1,
+         "hash": "aa1", "height": 1, "lead": 1},
+        {"kind": "attack_release", "node": 1, "lamport": 3, "step": 2,
+         "count": 1, "tip": "aa1", "height": 1, "lead": 1},
+        # attacker mines a child AFTER releasing...
+        {"kind": "mine", "node": 1, "lamport": 4, "step": 3,
+         "hash": "aa2", "prev": "aa1", "height": 2},
+        # ...and the slow receiver adopts the DESCENDANT tip.
+        {"kind": "adopt", "node": 0, "lamport": 5, "step": 4,
+         "peer": 1, "new_tip": "aa2", "height": 2, "adopted": 2,
+         "rolled_back": 1, "old_tip": "bb1"},
+    ]
+    from mpi_blockchain_tpu.forensics.fork_tree import build_fork_tree
+    tree = build_fork_tree(merged)
+    audit = attack_audit(merged, tree)
+    rel = audit["selfish"][0]["releases"][0]
+    assert rel["reorgs_caused"] == 1 and rel["max_reorg_depth"] == 1
