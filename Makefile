@@ -5,10 +5,10 @@ PY ?= python3
 SHELL := /bin/bash   # tier1 uses pipefail/PIPESTATUS
 
 .PHONY: check lint metrics-smoke forensics-smoke perf-smoke chaos-smoke \
-        adversary-smoke tier1 core clean
+        adversary-smoke meshwatch-smoke tier1 core clean
 
 check: lint metrics-smoke forensics-smoke perf-smoke chaos-smoke \
-        adversary-smoke tier1
+        adversary-smoke meshwatch-smoke tier1
 
 # chainlint: binding contract, header layout, JAX purity, sanitizer matrix.
 lint:
@@ -103,6 +103,16 @@ adversary-smoke:
 	      e['isolated_fork_len'], f['rejections']))" || \
 	    { echo "adversary-smoke: audit assertions failed"; rm -rf $$tmp; exit 1; }; \
 	rm -rf $$tmp
+
+# Meshwatch smoke: the ISSUE 7 gate — launch a 4-rank virtual-cpu world
+# with --mesh-obs, SIGKILL one rank mid-run, then the merged mesh view
+# must sum the per-rank hash counters, show every rank's heartbeat, name
+# exactly the killed rank as stale, and render a non-empty dispatch
+# pipeline report + Perfetto trace.
+meshwatch-smoke:
+	env JAX_PLATFORMS=cpu $(PY) -m mpi_blockchain_tpu.meshwatch smoke \
+	    2>/dev/null || { echo "meshwatch-smoke: failed"; exit 1; }; \
+	echo "meshwatch-smoke: ok"
 
 # Perfwatch smoke: serve a faulted instrumented run, scrape /metrics +
 # /healthz live, then prove the regression sentinel flags an injected

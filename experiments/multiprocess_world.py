@@ -8,7 +8,13 @@ result is compared byte-for-byte against the single-rank CPU oracle —
 the determinism contract across real process boundaries at the reference
 baseline's full rank count.
 
-Usage: python experiments/multiprocess_world.py [n_processes=8]
+Usage: python experiments/multiprocess_world.py [n_processes=8] [mesh_obs_dir]
+
+With a mesh_obs_dir (or env MPIBT_MESH_OBS), every rank additionally
+writes its telemetry shard there (``--mesh-obs``), and the summary line
+carries the MERGED mesh view's health + summed hash counters — the
+per-rank observability this launch shape exists to exercise
+(docs/observability.md §Mesh shards).
 """
 from __future__ import annotations
 
@@ -35,16 +41,22 @@ sys.exit(main({argv!r}))
 """
 
 
-def main(n_processes: int = 8) -> int:
+def main(n_processes: int = 8, mesh_obs: str | None = None) -> int:
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         port = s.getsockname()[1]
     tmp = tempfile.mkdtemp()
     out_file = tmp + "/chain.bin"
+    if mesh_obs is None:
+        mesh_obs = os.environ.get("MPIBT_MESH_OBS") or None
     base = ["mine", "--difficulty", str(DIFF), "--blocks", str(BLOCKS),
             "--backend", "tpu", "--kernel", "jnp", "--batch-pow2", "10",
             "--coordinator", f"127.0.0.1:{port}",
             "--num-processes", str(n_processes)]
+    if mesh_obs:
+        # Every rank shards its telemetry; rank identity comes from
+        # --process-id, so no extra env plumbing is needed.
+        base += ["--mesh-obs", mesh_obs]
     # Inherit the ambient environment (LD_LIBRARY_PATH, venv vars, ...)
     # and override only what the ranks must see differently; a minimal
     # hand-built env broke on machines whose interpreter needs more.
@@ -90,13 +102,40 @@ def main(n_processes: int = 8) -> int:
                                backend="cpu"), log_fn=lambda d: None)
     oracle.mine_chain()
     chain = pathlib.Path(out_file).read_bytes()
-    print(json.dumps({
+    summary = {
         "n_processes": n_processes, "difficulty": DIFF, "blocks": BLOCKS,
         "wall_s": wall, "tip": oracle.node.tip_hash.hex(),
         "identical_to_single_rank_oracle": chain == oracle.node.save(),
-    }))
+    }
+    if mesh_obs:
+        from mpi_blockchain_tpu.meshwatch import merge_shards, mesh_health
+        from mpi_blockchain_tpu.meshwatch.aggregate import read_shards
+
+        shards = read_shards(mesh_obs)
+        view = merge_shards(shards)
+        _, health = mesh_health(mesh_obs, shards=shards)
+        hashed = [v for v in view["counters"].values()
+                  if v["name"] == "hashes_tried_total"]
+        # Per-rank totals SUM across labelsets (a degraded rank counts
+        # hashes under two backend labels) — overwriting would make
+        # this disagree with the summed total below.
+        by_rank: dict = {}
+        for c in hashed:
+            for r, v in c["by_rank"].items():
+                by_rank[r] = by_rank.get(r, 0) + v
+        summary["mesh"] = {
+            "shards": len(shards),
+            "health": health["status"],
+            "live_or_finished": sorted(
+                int(r) for r, v in health["ranks"].items()
+                if v["status"] in ("ok", "finished")),
+            "hashes_tried_total": sum(v["total"] for v in hashed),
+            "hashes_by_rank": by_rank,
+        }
+    print(json.dumps(summary))
     return 0
 
 
 if __name__ == "__main__":
-    sys.exit(main(int(sys.argv[1]) if len(sys.argv) > 1 else 8))
+    sys.exit(main(int(sys.argv[1]) if len(sys.argv) > 1 else 8,
+                  sys.argv[2] if len(sys.argv) > 2 else None))

@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import os
 import pathlib
 import sys
 import time
@@ -47,86 +48,124 @@ SINGLE_CHIP_PLATEAU_MHS = 970.0
 
 def launch(n_miners: int = 8, preset_overrides: dict | None = None,
            blocks_per_call: int = 500,
-           expected_tip: str | None = PINNED_TIP_1000_D24) -> dict:
+           expected_tip: str | None = PINNED_TIP_1000_D24,
+           mesh_obs: str | None = None) -> dict:
     """Preflight + run config 4 on an n_miners mesh; returns the report.
 
     preset_overrides shrinks the run for the CI twin (difficulty,
     n_blocks, kernel, batch); the production call uses the literal
     tpu-mesh8 preset. Raises RuntimeError on any launch-blocking failure
     (missing devices, compile failure, wrong tip, invalid chain).
+    ``mesh_obs`` (or env MPIBT_MESH_OBS) shards this process's telemetry
+    for mesh-wide aggregation, and the report carries the dispatch
+    pipeline's overlap/bubble numbers either way — the evidence the
+    scale-out claim is judged against (docs/perfwatch.md §Pipeline).
     """
     import jax
 
     from mpi_blockchain_tpu import core
     from mpi_blockchain_tpu.config import PRESETS
+    from mpi_blockchain_tpu.meshwatch import pipeline_report
+    from mpi_blockchain_tpu.meshwatch.pipeline import reset_profiler
+    from mpi_blockchain_tpu.meshwatch.shard import ShardWriter
     from mpi_blockchain_tpu.models.fused import FusedMiner
     from mpi_blockchain_tpu.parallel.mesh import make_miner_mesh
 
     report: dict = {"event": "v5e8_launch"}
+    mesh_obs = mesh_obs or os.environ.get("MPIBT_MESH_OBS") or None
+    shard_writer = None
+    if mesh_obs:
+        shard_writer = ShardWriter(mesh_obs, rank=jax.process_index(),
+                                   world_size=jax.process_count())
+        shard_writer.start()
+        report["mesh_obs"] = mesh_obs
+    reset_profiler()   # the report below must price THIS run's dispatches
 
-    # ---- preflight ------------------------------------------------------
-    devices = jax.devices()
-    report["platform"] = devices[0].platform
-    report["devices_visible"] = len(devices)
-    if len(devices) < n_miners:
-        raise RuntimeError(
-            f"preflight: need {n_miners} devices, have {len(devices)} "
-            f"({devices[0].platform})")
-    if not preset_overrides and devices[0].platform == "cpu":
-        # The literal config 4 (1000 @ diff 24) on a virtual CPU mesh
-        # would grind for hours on the jnp fallback — only the CI twin
-        # (which shrinks the run via preset_overrides) belongs there.
-        raise RuntimeError(
-            "preflight: production config 4 expects real TPU devices; "
-            "found the cpu platform")
-    mesh = make_miner_mesh(n_miners)
-    report["mesh"] = str(dict(mesh.shape))
+    try:
+        # ---- preflight --------------------------------------------------
+        devices = jax.devices()
+        report["platform"] = devices[0].platform
+        report["devices_visible"] = len(devices)
+        if len(devices) < n_miners:
+            raise RuntimeError(
+                f"preflight: need {n_miners} devices, have {len(devices)} "
+                f"({devices[0].platform})")
+        if not preset_overrides and devices[0].platform == "cpu":
+            # The literal config 4 (1000 @ diff 24) on a virtual CPU mesh
+            # would grind for hours on the jnp fallback — only the CI twin
+            # (which shrinks the run via preset_overrides) belongs there.
+            raise RuntimeError(
+                "preflight: production config 4 expects real TPU devices; "
+                "found the cpu platform")
+        mesh = make_miner_mesh(n_miners)
+        report["mesh"] = str(dict(mesh.shape))
 
-    cfg = dataclasses.replace(PRESETS["tpu-mesh8"], n_miners=n_miners,
-                              **(preset_overrides or {}))
-    report["config"] = dataclasses.asdict(cfg)
-    miner = FusedMiner(cfg, blocks_per_call=blocks_per_call, mesh=mesh,
-                       log_fn=lambda d: None)
-    t0 = time.perf_counter()
-    miner.warmup()
-    if cfg.n_blocks % blocks_per_call:
-        miner.warmup(cfg.n_blocks % blocks_per_call)
-    report["compile_s"] = round(time.perf_counter() - t0, 3)
+        cfg = dataclasses.replace(PRESETS["tpu-mesh8"], n_miners=n_miners,
+                                  **(preset_overrides or {}))
+        report["config"] = dataclasses.asdict(cfg)
+        miner = FusedMiner(cfg, blocks_per_call=blocks_per_call, mesh=mesh,
+                           log_fn=lambda d: None)
+        t0 = time.perf_counter()
+        miner.warmup()
+        if cfg.n_blocks % blocks_per_call:
+            miner.warmup(cfg.n_blocks % blocks_per_call)
+        report["compile_s"] = round(time.perf_counter() - t0, 3)
 
-    # ---- the run (config 4, literally) ----------------------------------
-    t0 = time.perf_counter()
-    miner.mine_chain()
-    wall = time.perf_counter() - t0
-    if miner.node.height != cfg.n_blocks:
-        raise RuntimeError(f"mined {miner.node.height}/{cfg.n_blocks}")
-    # Full PoW + linkage re-validation through the C++ loader.
-    if not core.Node(cfg.difficulty_bits, 0).load(miner.node.save()):
-        raise RuntimeError("mined chain failed C++ revalidation")
+        # ---- the run (config 4, literally) ------------------------------
+        t0 = time.perf_counter()
+        miner.mine_chain()
+        wall = time.perf_counter() - t0
+        if miner.node.height != cfg.n_blocks:
+            raise RuntimeError(f"mined {miner.node.height}/{cfg.n_blocks}")
+        # Full PoW + linkage re-validation through the C++ loader.
+        if not core.Node(cfg.difficulty_bits, 0).load(miner.node.save()):
+            raise RuntimeError("mined chain failed C++ revalidation")
 
-    tip = miner.node.tip_hash.hex()
-    expected_hashes = cfg.n_blocks * (1 << cfg.difficulty_bits)
-    report.update({
-        "n_blocks": cfg.n_blocks, "difficulty_bits": cfg.difficulty_bits,
-        "wall_s": round(wall, 3),
-        "blocks_per_sec": round(cfg.n_blocks / wall, 1),
-        "effective_mhs_total": round(expected_hashes / wall / 1e6, 1),
-        "effective_mhs_per_chip": round(
-            expected_hashes / wall / n_miners / 1e6, 1),
-        "scaling_efficiency_vs_plateau": round(
-            expected_hashes / wall / 1e6
-            / (n_miners * SINGLE_CHIP_PLATEAU_MHS), 3),
-        "tip_hash": tip,
-    })
-    if expected_tip is not None:
-        report["tip_matches_preregistered"] = tip == expected_tip
-        if tip != expected_tip:
-            err = RuntimeError(
-                f"LAUNCH FAILURE: tip {tip} != pre-registered "
-                f"{expected_tip} — the determinism contract is broken")
-            # Keep the measured wall/rates/config with the failure: the
-            # multi-second run's diagnostics are needed to debug it.
-            err.report = report
-            raise err
+        tip = miner.node.tip_hash.hex()
+        expected_hashes = cfg.n_blocks * (1 << cfg.difficulty_bits)
+        report.update({
+            "n_blocks": cfg.n_blocks,
+            "difficulty_bits": cfg.difficulty_bits,
+            "wall_s": round(wall, 3),
+            "blocks_per_sec": round(cfg.n_blocks / wall, 1),
+            "effective_mhs_total": round(expected_hashes / wall / 1e6, 1),
+            "effective_mhs_per_chip": round(
+                expected_hashes / wall / n_miners / 1e6, 1),
+            "scaling_efficiency_vs_plateau": round(
+                expected_hashes / wall / 1e6
+                / (n_miners * SINGLE_CHIP_PLATEAU_MHS), 3),
+            "tip_hash": tip,
+        })
+        # Dispatch pipeline evidence: overlap/bubble of THIS run's fused
+        # dispatches (the async-dispatch item's before/after number).
+        pipe = pipeline_report()
+        report["pipeline"] = {
+            "dispatches": pipe["dispatch_count"],
+            "bubble_fraction": pipe["bubble_fraction"],
+            "host_overlapped_fraction": pipe["host_overlapped_fraction"],
+        }
+        if expected_tip is not None:
+            report["tip_matches_preregistered"] = tip == expected_tip
+            if tip != expected_tip:
+                err = RuntimeError(
+                    f"LAUNCH FAILURE: tip {tip} != pre-registered "
+                    f"{expected_tip} — the determinism contract is broken")
+                # Keep the measured wall/rates/config with the failure:
+                # the multi-second run's diagnostics are needed to debug.
+                err.report = report
+                raise err
+    except BaseException:
+        # Failure: stop the flusher WITHOUT a final write, so the frozen
+        # shard ages into staleness — a failed launch must read as a
+        # stale rank in the merged mesh view even when the caller keeps
+        # this process alive (and never as a live one kept fresh by a
+        # leaked flusher thread).
+        if shard_writer is not None:
+            shard_writer.abort()
+        raise
+    # A clean launch says goodbye with a FINAL rc-0 shard.
+    if shard_writer is not None:
+        shard_writer.close(status=0)
     return report
 
 

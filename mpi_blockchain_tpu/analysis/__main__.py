@@ -17,7 +17,7 @@ OVERRIDE_KEYS = ("capi", "ctypes_binding", "pybind", "chain_hpp",
                  "chain_cpp", "core_init", "sha_jnp", "header_test",
                  "mesh_py", "core_makefile", "core_src", "sim_py",
                  "telemetry_files", "resilience_files",
-                 "adversary_files")
+                 "adversary_files", "rank_scope_files")
 
 
 def main(argv: list[str] | None = None) -> int:

@@ -23,9 +23,22 @@ a future edit that emits a bus event through the raw JSON-lines stream
           string) names are checked — f-string families like
           ``sim_group_{field}`` are the call site's responsibility.
 
+  TEL003  a hand-rolled ``rank`` label in a multi-rank code path: a
+          ``counter``/``gauge``/``histogram`` call passing ``rank=...``
+          directly. The meshwatch aggregator merges per-rank samples on
+          the ``rank`` label, so the label must be ONE convention —
+          stamped by ``telemetry.rank_counter``/``rank_gauge``/
+          ``rank_histogram`` (which default it to the process's declared
+          mesh rank) — or an 8-rank merge silently splits one series
+          into differently-spelled ones.
+
 Scope: TEL001 over ``mpi_blockchain_tpu/simulation.py`` (the bus
 surface; override key ``sim_py``); TEL002 over every ``.py`` in the
-package (override key ``telemetry_files`` — the drift-fixture seam).
+package (override key ``telemetry_files`` — the drift-fixture seam);
+TEL003 over the multi-rank surfaces — ``parallel/``, ``meshwatch/``,
+``bench_lib.py``, and the multiprocess experiments
+(``experiments/multiprocess_world.py``, ``experiments/v5e8_launch.py``;
+override key ``rank_scope_files``).
 """
 from __future__ import annotations
 
@@ -101,6 +114,25 @@ def _package_py_files(root: pathlib.Path) -> list[pathlib.Path]:
     return sorted(p for p in pkg.rglob("*.py") if "__pycache__" not in p.parts)
 
 
+def _rank_scope_files(root: pathlib.Path) -> list[pathlib.Path]:
+    """TEL003's multi-rank surface: everywhere a per-rank metric can be
+    born (missing files are skipped — experiments are optional in a
+    wheel install)."""
+    pkg = root / "mpi_blockchain_tpu"
+    files: list[pathlib.Path] = []
+    for sub in ("parallel", "meshwatch"):
+        d = pkg / sub
+        if d.is_dir():
+            files.extend(p for p in d.rglob("*.py")
+                         if "__pycache__" not in p.parts)
+    for extra in (pkg / "bench_lib.py",
+                  root / "experiments" / "multiprocess_world.py",
+                  root / "experiments" / "v5e8_launch.py"):
+        if extra.is_file():
+            files.append(extra)
+    return sorted(files)
+
+
 def _run_naming_lint(root: pathlib.Path, files) -> list[Finding]:
     """TEL002 over every metric registration with a literal name."""
     findings: list[Finding] = []
@@ -133,6 +165,38 @@ def _run_naming_lint(root: pathlib.Path, files) -> list[Finding]:
     return findings
 
 
+def _run_rank_label_lint(root: pathlib.Path, files) -> list[Finding]:
+    """TEL003: no hand-rolled ``rank=`` label on a raw registry call in
+    multi-rank code."""
+    findings: list[Finding] = []
+    for path in files:
+        rel = (str(path.relative_to(root)) if path.is_relative_to(root)
+               else str(path))
+        try:
+            tree = ast.parse(path.read_text(), filename=str(path))
+        except SyntaxError as e:
+            findings.append(Finding(rel, e.lineno or 1, "TEL000",
+                                    f"syntax error: {e.msg}"))
+            continue
+        except OSError:
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            kind = _call_name(node)
+            if kind not in ("counter", "gauge", "histogram"):
+                continue
+            if any(kw.arg == "rank" for kw in node.keywords):
+                findings.append(Finding(
+                    rel, node.lineno, "TEL003",
+                    f"hand-rolled rank label on {kind}() in a "
+                    f"multi-rank code path — use telemetry.rank_{kind} "
+                    f"so the `rank` label the mesh aggregator merges on "
+                    f"stays one convention (docs/observability.md "
+                    f"§Mesh shards)"))
+    return findings
+
+
 def run_telemetry_lint(root: pathlib.Path, overrides=None,
                        notes=None) -> list[Finding]:
     overrides = overrides or {}
@@ -142,6 +206,12 @@ def run_telemetry_lint(root: pathlib.Path, overrides=None,
     elif isinstance(tel_files, (str, pathlib.Path)):
         tel_files = [pathlib.Path(tel_files)]
     findings: list[Finding] = list(_run_naming_lint(root, tel_files))
+    rank_files = overrides.get("rank_scope_files")
+    if rank_files is None:
+        rank_files = _rank_scope_files(root)
+    elif isinstance(rank_files, (str, pathlib.Path)):
+        rank_files = [pathlib.Path(rank_files)]
+    findings.extend(_run_rank_label_lint(root, rank_files))
     sim_py = overrides.get(
         "sim_py", root / "mpi_blockchain_tpu" / "simulation.py")
     rel = (str(sim_py.relative_to(root)) if sim_py.is_relative_to(root)

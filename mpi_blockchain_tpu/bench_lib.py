@@ -14,7 +14,7 @@ import threading
 import time
 
 from . import core
-from .telemetry import counter, emit_event, gauge, heartbeat
+from .telemetry import counter, emit_event, gauge, heartbeat, rank_counter
 from .telemetry.events import env_number
 from .telemetry.spans import span
 
@@ -94,21 +94,28 @@ def bench_cpu(seconds: float = 3.0, n_miners: int = 1,
         deadline = time.perf_counter() + seconds
         base = rank * (1 << 28)
         hb = heartbeat("bench_heartbeat")
+        # Per-rank attribution rides the rank-aware helper (TEL003):
+        # the merged mesh view shows which thread-rank hashed what.
+        rank_c = rank_counter("bench_rank_hashes_total",
+                              help="nonces hashed per bench rank",
+                              rank=rank, backend="cpu")
         while time.perf_counter() < deadline:
             _, t = core.cpu_search(_HEADER, base, chunk,
                                    _IMPOSSIBLE_DIFFICULTY)
             tried += t
             hashes_c.inc(t)
+            rank_c.inc(t)
             hb.inc()
             base += chunk
         return tried
 
     t0 = time.perf_counter()
     if n_miners == 1:
-        total = one_rank(0)
+        per_rank = [one_rank(0)]
     else:
         with concurrent.futures.ThreadPoolExecutor(n_miners) as pool:
-            total = sum(pool.map(one_rank, range(n_miners)))
+            per_rank = list(pool.map(one_rank, range(n_miners)))
+    total = sum(per_rank)
     wall = time.perf_counter() - t0
     gauge("bench_hashes_per_sec",
           help="last measured sweep throughput",
@@ -116,7 +123,10 @@ def bench_cpu(seconds: float = 3.0, n_miners: int = 1,
     return {"backend": "cpu", "n_miners": n_miners,
             "hashes": total, "wall_s": round(wall, 3),
             "hashes_per_sec": total / wall,
-            "hashes_per_sec_per_rank": total / wall / n_miners}
+            "hashes_per_sec_per_rank": total / wall / n_miners,
+            "per_rank": [{"rank": i, "hashes": t,
+                          "hashes_per_sec": round(t / wall, 1)}
+                         for i, t in enumerate(per_rank)]}
 
 
 def bench_tpu(seconds: float = 5.0, batch_pow2: int = 28,
@@ -185,11 +195,27 @@ def bench_tpu(seconds: float = 5.0, batch_pow2: int = 28,
     gauge("bench_hashes_per_sec",
           help="last measured sweep throughput", backend="tpu").set(
         tried / wall)
-    return {"backend": "tpu", "n_miners": n_miners, "kernel": kernel,
-            "batch_pow2": batch_pow2, "platform": jax.default_backend(),
-            "hashes": tried, "wall_s": round(wall, 3),
-            "hashes_per_sec": tried / wall,
-            "hashes_per_sec_per_chip": tried / wall / n_miners}
+    result = {"backend": "tpu", "n_miners": n_miners, "kernel": kernel,
+              "batch_pow2": batch_pow2, "platform": jax.default_backend(),
+              "hashes": tried, "wall_s": round(wall, 3),
+              "hashes_per_sec": tried / wall,
+              "hashes_per_sec_per_chip": tried / wall / n_miners}
+    if n_miners > 1:
+        # Multichip breakdown: every mesh device sweeps exactly `batch`
+        # nonces per round (disjoint stripes by construction), so the
+        # per-chip share is exact — recorded per-rank so the multichip
+        # bench payload and the merged mesh view agree chip by chip.
+        per_chip = tried // n_miners
+        devices = list(mesh.devices.flat)
+        for i, dev in enumerate(devices):
+            rank_counter("bench_rank_hashes_total",
+                         help="nonces hashed per bench rank",
+                         rank=i, backend="tpu").inc(per_chip)
+        result["per_rank"] = [
+            {"rank": i, "device": str(dev), "hashes": per_chip,
+             "hashes_per_sec": round(per_chip / wall, 1)}
+            for i, dev in enumerate(devices)]
+    return result
 
 
 def bench_chain(n_blocks: int = 1000, difficulty_bits: int = 24,

@@ -67,6 +67,14 @@ def _add_metrics_dump_arg(p: argparse.ArgumentParser) -> None:
                         "the duration of the run (0 = ephemeral port, "
                         "announced on stderr; env MPIBT_METRICS_PORT also "
                         "enables it)")
+    p.add_argument("--mesh-obs", metavar="DIR", default=None,
+                   help="write this rank's telemetry shard (registry "
+                        "snapshot + heartbeats + pipeline records) into "
+                        "DIR on a background flusher, for mesh-wide "
+                        "aggregation with python -m "
+                        "mpi_blockchain_tpu.meshwatch (env MPIBT_MESH_OBS "
+                        "also arms it; rank from --process-id or "
+                        "MPIBT_MESH_RANK)")
     p.add_argument("--fault-plan", metavar="PATH|seed:N", default=None,
                    help="arm the deterministic fault-injection harness "
                         "with a JSON fault plan (or a seed-derived one); "
@@ -208,6 +216,7 @@ def cmd_mine(args) -> int:
         if not args.checkpoint:
             raise ConfigError("--checkpoint-every needs --checkpoint PATH "
                               "(where to save)")
+        from .meshwatch.pipeline import profiler as _profiler
         from .resilience.policy import call_with_retry
         from .utils.checkpoint import save_chain as _periodic_save
         every = args.checkpoint_every
@@ -215,11 +224,15 @@ def cmd_mine(args) -> int:
         def on_block(rec):
             # Retry transient FS errors under the checkpoint.write
             # budget — a periodic save must not kill a long mining run.
+            # The save is timed as the dispatch pipeline's `checkpoint`
+            # segment: it sits on the critical path between sweeps, so
+            # it belongs in the bubble accounting.
             if rec.height % every == 0:
-                call_with_retry(
-                    lambda: _periodic_save(miner.node, args.checkpoint,
-                                           cfg),
-                    site="checkpoint.write")
+                with _profiler().segment_on_last("checkpoint"):
+                    call_with_retry(
+                        lambda: _periodic_save(miner.node, args.checkpoint,
+                                               cfg),
+                        site="checkpoint.write")
         if not is_main:
             # Multi-process world: every rank mines the identical chain,
             # so only the main process writes the shared checkpoint —
@@ -235,10 +248,11 @@ def cmd_mine(args) -> int:
         if args.fused:
             # The fused loop appends whole device spans; checkpoint at
             # span boundaries (every span IS >= 1 block of progress).
+            def _fused_save(height):
+                with _profiler().segment_on_last("checkpoint"):
+                    _periodic_save(miner.node, args.checkpoint, cfg)
             miner.mine_chain(remaining, on_progress=(
-                (lambda height: _periodic_save(miner.node,
-                                               args.checkpoint, cfg))
-                if on_block is not None else None))
+                _fused_save if on_block is not None else None))
         else:
             miner.mine_chain(remaining, on_block=on_block)
     wall = time.perf_counter() - t0
@@ -751,6 +765,38 @@ def main(argv: list[str] | None = None) -> int:
             print(f"serving metrics on http://127.0.0.1:{port} "
                   f"(/metrics /healthz /events)", file=sys.stderr,
                   flush=True)
+    mesh_obs = getattr(args, "mesh_obs", None)
+    if mesh_obs is None and hasattr(args, "mesh_obs"):
+        # Env fallback only for subcommands that take the flag
+        # (mine/sim/bench) — same scoping rule as MPIBT_METRICS_PORT.
+        mesh_obs = os.environ.get("MPIBT_MESH_OBS") or None
+    shard_armed = False
+    # The exit status the final shard carries: overwritten on every
+    # handled path below; an UNHANDLED exception leaves "error", so a
+    # crashed rank can never read as cleanly finished in the mesh view.
+    exit_status: int | str = "error"
+    if mesh_obs:
+        from .meshwatch import shard as _mesh_shard
+        from .telemetry.events import env_number as _env_number
+
+        # Rank identity: the multi-process launch flag wins; standalone
+        # ranks (one process per rank, no coordinator) are labeled via
+        # MPIBT_MESH_RANK / MPIBT_MESH_WORLD by whatever launched them.
+        rank = getattr(args, "process_id", None)
+        if rank is None:
+            rank = _env_number("MPIBT_MESH_RANK", 0, cast=int, minimum=0)
+        world = getattr(args, "num_processes", None)
+        if world is None:
+            world = _env_number("MPIBT_MESH_WORLD", 1, cast=int, minimum=1)
+        try:
+            _mesh_shard.install(mesh_obs, rank=rank, world_size=world)
+        except OSError as e:
+            # An unwritable shard dir must not kill the run it observes.
+            print(f"mesh-obs failed: {e}", file=sys.stderr)
+        else:
+            shard_armed = True
+            print(f"mesh-obs: rank {rank}/{world} shard -> {mesh_obs}",
+                  file=sys.stderr, flush=True)
     try:
         if fault_arg:
             from .resilience import injection
@@ -770,6 +816,7 @@ def main(argv: list[str] | None = None) -> int:
             plan_armed = False
             from .resilience import injection
             injection.disarm(strict=(rc == 0))
+        exit_status = rc
         return rc
     except FaultPlanError as e:
         # Before ConfigError: FaultPlanError subclasses it, and CI must
@@ -777,6 +824,7 @@ def main(argv: list[str] | None = None) -> int:
         # config / exhausted retries" (2).
         print(json.dumps({"event": "error", "kind": "fault_plan",
                           "error": str(e)}, sort_keys=True))
+        exit_status = 3
         return 3
     except RetryExhausted as e:
         # The policy layer gave up after every attempt and every ladder
@@ -784,6 +832,7 @@ def main(argv: list[str] | None = None) -> int:
         print(json.dumps({"event": "error", "kind": "retry_exhausted",
                           "site": e.site, "error": str(e)},
                          sort_keys=True))
+        exit_status = 2
         return 2
     except ConfigError as e:
         # Config/topology errors (oversubscribed mesh, bad kernel/batch,
@@ -794,6 +843,7 @@ def main(argv: list[str] | None = None) -> int:
         # keeps its traceback.
         print(json.dumps({"event": "error", "error": str(e)},
                          sort_keys=True))
+        exit_status = 2
         return 2
     finally:
         if plan_armed:
@@ -811,6 +861,14 @@ def main(argv: list[str] | None = None) -> int:
                 dump_metrics(args.metrics_dump)
             except OSError as e:
                 print(f"metrics-dump failed: {e}", file=sys.stderr)
+        # The FINAL shard says goodbye AND how it went: rc 0 reads as
+        # `finished` in the merged mesh view, any other rc (or "error"
+        # for an uncaught exception passing through here) as `failed` —
+        # a badly-exited rank must never look cleanly done. A rank that
+        # dies before reaching here is the stale-rank case instead.
+        if shard_armed:
+            from .meshwatch import shard as _mesh_shard
+            _mesh_shard.uninstall(status=exit_status)
         # The endpoint must release its port on EVERY exit path — an
         # uncaught exception passes through here on its way to the
         # flight-recorder excepthook, and a wedged scrape thread is

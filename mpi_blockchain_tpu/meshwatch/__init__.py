@@ -1,0 +1,38 @@
+"""meshwatch — per-rank telemetry shards, mesh-wide aggregation, and the
+dispatch pipeline profiler.
+
+Every observability layer before this one (registry, causal logs,
+perfwatch server) is process-local: in an 8-rank world, rank 0's
+``/metrics`` says nothing about ranks 1–7. meshwatch closes that gap
+with three pieces (docs/observability.md §Mesh shards,
+docs/perfwatch.md §Mesh healthz / §Pipeline report):
+
+* **shard** — each rank atomically writes a rank-stamped shard file
+  (registry snapshot + heartbeats + event/causal tails + pipeline
+  records) into a shared directory on a background flusher
+  (``--mesh-obs DIR`` / ``MPIBT_MESH_OBS`` on mine/sim/bench). A clean
+  exit writes a ``final`` shard; a SIGKILL'd rank leaves a non-final
+  shard whose age keeps growing — that asymmetry IS the dead-rank
+  signal.
+* **aggregate** — merges shards into one mesh view: counters summed
+  across ranks, gauges/histograms kept per-rank under a ``rank`` label,
+  heartbeats compared; ``mesh_health`` names stale/missing ranks
+  (``mesh_rank_stale`` event + ``mesh_live_ranks`` gauge), feeding the
+  mesh-aware ``/healthz`` served by ``meshwatch watch``.
+* **pipeline** — times every miner dispatch's segments (enqueue, device
+  in-flight, validate, append, checkpoint) into a bounded ring, computes
+  per-dispatch overlap and the mesh's device bubble fraction (the number
+  the async-dispatch roadmap item must drive to ~0), and exports a
+  wall-clock Perfetto timeline with one track per rank and stage.
+
+CLI: ``python -m mpi_blockchain_tpu.meshwatch {merge,report,watch,smoke}``
+(``make meshwatch-smoke`` gates on ``smoke``). Standard library only;
+importing this package never pulls in jax.
+"""
+from __future__ import annotations
+
+from .aggregate import (merge_shards, mesh_health,  # noqa: F401
+                        read_shards, render_mesh_prometheus)
+from .pipeline import (PipelineProfiler, pipeline_report,  # noqa: F401
+                       profiler, reset_profiler, to_chrome_trace)
+from .shard import ShardWriter, install, installed, uninstall  # noqa: F401

@@ -1,0 +1,293 @@
+"""CLI: python -m mpi_blockchain_tpu.meshwatch {merge,report,watch,smoke}
+
+    # one mesh-wide view of a shard directory (counters summed,
+    # gauges/histograms per-rank), with rank liveness
+    python -m mpi_blockchain_tpu.meshwatch merge --dir /tmp/mesh
+
+    # dispatch pipeline report (+ wall-clock Perfetto trace) from the
+    # shards' profiler records
+    python -m mpi_blockchain_tpu.meshwatch report --dir /tmp/mesh \\
+        --trace pipeline_trace.json
+
+    # serve the mesh-aware /healthz /metrics /ranks until interrupted
+    python -m mpi_blockchain_tpu.meshwatch watch --dir /tmp/mesh --port 0
+
+``smoke`` is the CI shape (``make meshwatch-smoke``): launch a 4-rank
+virtual-cpu world with ``--mesh-obs``, SIGKILL one rank mid-run, then
+prove the merged view sums the per-rank counters, names exactly the
+killed rank as stale, and renders a non-empty pipeline report + trace.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+from .aggregate import merge_shards, mesh_health, read_shards, \
+    render_mesh_prometheus
+from .pipeline import pipeline_report, to_chrome_trace
+
+
+def _shard_pipeline_records(shards: list[dict]) -> list[dict]:
+    """Every shard's profiler-record tail, concatenated (records carry
+    their rank, so cross-rank analysis needs no extra bookkeeping)."""
+    records: list[dict] = []
+    for shard in shards:
+        records.extend(shard.get("pipeline") or [])
+    return records
+
+
+def cmd_merge(args) -> int:
+    shards = read_shards(args.dir)
+    code, health = mesh_health(args.dir, stall_s=args.stall_s,
+                               shards=shards)
+    view = merge_shards(shards)
+    if args.prometheus:
+        sys.stdout.write(render_mesh_prometheus(view, health))
+    else:
+        print(json.dumps({"event": "meshwatch_merge",
+                          "dir": str(args.dir),
+                          "health": health, "view": view},
+                         sort_keys=True, default=str))
+    if args.check and code != 200:
+        return 1
+    return 0
+
+
+def cmd_report(args) -> int:
+    if args.dir:
+        records = _shard_pipeline_records(read_shards(args.dir))
+    else:
+        from .pipeline import profiler
+        records = profiler().records()
+    report = pipeline_report(records)
+    out = {"event": "meshwatch_report",
+           "source": str(args.dir) if args.dir else "in-process",
+           "pipeline": report}
+    if args.trace:
+        trace = to_chrome_trace(records)
+        pathlib.Path(args.trace).write_text(
+            json.dumps(trace, sort_keys=True))
+        out["trace"] = {"path": str(args.trace),
+                        "events": len(trace["traceEvents"])}
+    print(json.dumps(out, sort_keys=True))
+    return 0
+
+
+def cmd_watch(args) -> int:
+    if args.once:
+        code, payload = mesh_health(args.dir, stall_s=args.stall_s)
+        print(json.dumps(payload, sort_keys=True))
+        return 0 if code == 200 else 1
+    from .server import MeshServer
+
+    srv = MeshServer(args.dir, port=args.port, host=args.host,
+                     stall_s=args.stall_s)
+    port = srv.start()
+    print(json.dumps({"event": "meshwatch_watch", "dir": str(args.dir),
+                      "host": args.host, "port": port,
+                      "endpoints": ["/healthz", "/metrics", "/ranks"]}),
+          flush=True)
+    try:
+        import threading
+        threading.Event().wait()            # serve until interrupted
+    except KeyboardInterrupt:
+        pass
+    finally:
+        srv.close()
+    return 0
+
+
+# ---- smoke ----------------------------------------------------------------
+
+
+def _spawn_rank(rank: int, world: int, obs_dir: str, difficulty: int,
+                blocks: int):
+    import os
+    import subprocess
+
+    env = {**os.environ,
+           "JAX_PLATFORMS": "cpu",
+           "MPIBT_MESH_RANK": str(rank),
+           "MPIBT_MESH_WORLD": str(world),
+           "MPIBT_MESH_OBS_INTERVAL": "0.2"}
+    argv = [sys.executable, "-m", "mpi_blockchain_tpu", "mine",
+            "--backend", "cpu", "--difficulty", str(difficulty),
+            "--blocks", str(blocks), "--mesh-obs", obs_dir]
+    return subprocess.Popen(argv, env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True)
+
+
+def cmd_smoke(args) -> int:
+    """The make meshwatch-smoke gate: 4-rank world, one SIGKILL'd."""
+    import signal
+    import tempfile
+    import time
+
+    from .shard import shard_path
+
+    world, victim = 4, 2
+    with tempfile.TemporaryDirectory() as tmp:
+        obs = str(pathlib.Path(tmp) / "mesh")
+        survivors = [_spawn_rank(r, world, obs, difficulty=10, blocks=20)
+                     for r in range(world) if r != victim]
+        # The victim mines a long chain so it is still sweeping when the
+        # signal lands — a real mid-run death, not a post-exit one.
+        victim_proc = _spawn_rank(victim, world, obs, difficulty=20,
+                                  blocks=4000)
+        try:
+            deadline = time.monotonic() + 60
+            vpath = shard_path(obs, victim)
+            while time.monotonic() < deadline:
+                shards = {s["rank"]: s for s in read_shards(obs)}
+                beats = shards.get(victim, {}).get("heartbeats", {})
+                # Kill only once the victim's shard PROVES it was mining
+                # (a heartbeat in flight) — the mid-run death the stale
+                # detection exists for, not a pre-start one.
+                if vpath.exists() and any("miner_heartbeat" in k
+                                          for k in beats):
+                    break
+                time.sleep(0.1)
+            else:
+                print("meshwatch-smoke: victim never heartbeat",
+                      file=sys.stderr)
+                return 1
+            victim_proc.send_signal(signal.SIGKILL)
+            victim_proc.wait(timeout=30)
+            for p in survivors:
+                out, err = p.communicate(timeout=120)
+                if p.returncode != 0:
+                    print(f"meshwatch-smoke: survivor rank failed "
+                          f"rc={p.returncode}: {err[-800:]}",
+                          file=sys.stderr)
+                    return 1
+        finally:
+            for p in survivors + [victim_proc]:
+                if p.poll() is None:
+                    p.kill()
+                    p.wait()
+        time.sleep(0.6)    # let the victim's shard age past the budget
+        shards = read_shards(obs)
+        view = merge_shards(shards)
+        code, health = mesh_health(obs, stall_s=0.5, shards=shards)
+
+        # 1. counters sum: merged hashes_tried_total == sum of per-rank.
+        hashed = [v for k, v in view["counters"].items()
+                  if v["name"] == "hashes_tried_total"]
+        if not hashed or any(
+                v["total"] != sum(v["by_rank"].values()) for v in hashed):
+            print(f"meshwatch-smoke: counter sum broken: {hashed}",
+                  file=sys.stderr)
+            return 1
+        rank_set = {r for v in hashed for r in v["by_rank"]}
+        if not {"0", "1", "3"} <= rank_set:
+            print(f"meshwatch-smoke: survivor counters missing: "
+                  f"{sorted(rank_set)}", file=sys.stderr)
+            return 1
+
+        # 2. the killed rank — and ONLY it — reads stale; survivors
+        #    finished (final shards are not stale).
+        if code != 503 or health["stale_ranks"] != [victim]:
+            print(f"meshwatch-smoke: expected stale rank [{victim}], "
+                  f"got {health['stale_ranks']} (code {code})",
+                  file=sys.stderr)
+            return 1
+        finished = [r for r, v in health["ranks"].items()
+                    if v["status"] == "finished"]
+        if sorted(int(r) for r in finished) != [0, 1, 3]:
+            print(f"meshwatch-smoke: survivors not finished: {finished}",
+                  file=sys.stderr)
+            return 1
+
+        # 3. per-rank heartbeats individually visible in the merged view.
+        beats = {r for r, b in view["heartbeats"].items()
+                 if any("miner_heartbeat" in k for k in b)}
+        if not {"0", "1", "2", "3"} <= beats:
+            print(f"meshwatch-smoke: heartbeats missing: {sorted(beats)}",
+                  file=sys.stderr)
+            return 1
+
+        # 4. the pipeline report renders with real dispatch segments.
+        records = _shard_pipeline_records(shards)
+        report = pipeline_report(records)
+        if not report["dispatch_count"] or report["bubble_fraction"] is None:
+            print(f"meshwatch-smoke: empty pipeline report: {report}",
+                  file=sys.stderr)
+            return 1
+        trace = to_chrome_trace(records)
+        pids = {e["pid"] for e in trace["traceEvents"]
+                if e["ph"] in ("X", "b")}
+        if len(pids) < 2:
+            print(f"meshwatch-smoke: trace rows missing: {sorted(pids)}",
+                  file=sys.stderr)
+            return 1
+
+    print(json.dumps({
+        "event": "meshwatch_smoke", "ok": True,
+        "ranks": sorted(int(r) for r in rank_set),
+        "stale_ranks": health["stale_ranks"],
+        "hashes_total": sum(v["total"] for v in hashed),
+        "pipeline_dispatches": report["dispatch_count"],
+        "bubble_fraction": report["bubble_fraction"],
+    }, sort_keys=True))
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m mpi_blockchain_tpu.meshwatch",
+        description="per-rank telemetry shards, mesh aggregation, and "
+                    "the dispatch pipeline profiler")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p_mrg = sub.add_parser("merge", help="merge a shard directory into "
+                                         "one mesh view + health")
+    p_mrg.add_argument("--dir", required=True, metavar="DIR",
+                       help="the --mesh-obs shard directory")
+    p_mrg.add_argument("--stall-s", type=float, default=None,
+                       help="rank staleness budget (default "
+                            "MPIBT_MESH_STALL or 10)")
+    p_mrg.add_argument("--prometheus", action="store_true",
+                       help="emit the merged Prometheus text instead of "
+                            "JSON")
+    p_mrg.add_argument("--check", action="store_true",
+                       help="exit 1 when any rank is stale/missing")
+    p_mrg.set_defaults(fn=cmd_merge)
+
+    p_rep = sub.add_parser("report", help="dispatch pipeline report "
+                                          "(overlap/bubble) + Perfetto "
+                                          "trace")
+    p_rep.add_argument("--dir", default=None, metavar="DIR",
+                       help="shard directory (default: the in-process "
+                            "profiler)")
+    p_rep.add_argument("--trace", default=None, metavar="PATH",
+                       help="also write a wall-clock Chrome trace "
+                            "(one track per rank and stage; view at "
+                            "ui.perfetto.dev)")
+    p_rep.set_defaults(fn=cmd_report)
+
+    p_wch = sub.add_parser("watch", help="serve the mesh-aware /healthz "
+                                         "/metrics /ranks")
+    p_wch.add_argument("--dir", required=True, metavar="DIR")
+    p_wch.add_argument("--port", type=int, default=0,
+                       help="0 = ephemeral (announced on stdout)")
+    p_wch.add_argument("--host", default="127.0.0.1")
+    p_wch.add_argument("--stall-s", type=float, default=None)
+    p_wch.add_argument("--once", action="store_true",
+                       help="print the health JSON once and exit 0/1")
+    p_wch.set_defaults(fn=cmd_watch)
+
+    p_smk = sub.add_parser("smoke", help="the make meshwatch-smoke gate")
+    p_smk.set_defaults(fn=cmd_smoke)
+
+    args = parser.parse_args(argv)
+    try:
+        return args.fn(args)
+    except BrokenPipeError:
+        sys.stderr.close()
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,344 @@
+"""Dispatch pipeline profiler: where does a mine dispatch's wall go?
+
+The miner loop's hot cycle is dispatch-shaped: build inputs (*enqueue*),
+wait on the device (*device*), then host work (*validate*, *append*,
+*checkpoint*). The span summaries already say how much total time each
+layer ate; what they cannot say is whether those times OVERLAPPED —
+the fused loop dispatches batch i+1 before validating batch i, and the
+async-dispatch roadmap item is judged on exactly that overlap. This
+module records every dispatch as absolute-timestamped segments in a
+bounded ring and derives:
+
+* **device busy** — the union of every dispatch's ``device`` window
+  (from dispatch issue to result materialization: the host-visible
+  in-flight interval, the ``block_until_ready`` seam);
+* **bubble fraction** — ``1 - device_busy / wall``: the share of the
+  run's wall clock with NO dispatch in flight, i.e. the device idling
+  behind host work. This is the number async pipelining must drive to
+  ~0 (docs/perfwatch.md §Pipeline report);
+* **overlap** — host-segment time that coincides with a device window:
+  host work successfully hidden behind device compute. Reported
+  per-dispatch (this dispatch's device window ∩ all host segments) and
+  globally (``host_overlapped_fraction``).
+
+Timestamps are ``time.time()``-anchored monotonic floats: monotonic
+within a process (one anchor per profiler), wall-comparable across
+ranks on the same host — which is what lets ``meshwatch report`` lay
+every rank's dispatches on one Perfetto timeline (one process row per
+rank, one thread row per stage). Cross-host timelines inherit the
+hosts' clock skew; the forensics logical-time trace is the skew-free
+alternative.
+
+Records are plain dicts (JSON-able as-is) so shards can carry them
+verbatim:
+
+    {"dispatch": 3, "rank": 0, "meta": {...},
+     "segments": [{"stage": "device", "t0": ..., "t1": ...}, ...]}
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+from ..telemetry import mesh_rank
+
+#: Canonical stage names, in pipeline order. ``device`` is the in-flight
+#: window; everything else is host work.
+STAGES = ("enqueue", "device", "validate", "append", "checkpoint")
+HOST_STAGES = tuple(s for s in STAGES if s != "device")
+
+RING_SIZE = 4096
+
+
+class DispatchRecord:
+    """One dispatch's timed segments. Thread-compatible: the miner loop
+    mutates a record from one thread at a time."""
+
+    def __init__(self, profiler: "PipelineProfiler", dispatch_id: int,
+                 rank: int, meta: dict):
+        self._profiler = profiler
+        self.record = {"dispatch": dispatch_id, "rank": rank,
+                       "meta": meta, "segments": []}
+
+    def add_segment(self, stage: str, t0: float, t1: float) -> None:
+        self.record["segments"].append(
+            {"stage": str(stage), "t0": float(t0), "t1": float(t1)})
+
+    def segment(self, stage: str):
+        """``with rec.segment("append"): ...`` times one segment."""
+        return _SegmentCtx(self, stage)
+
+    def now(self) -> float:
+        return self._profiler.now()
+
+
+class _SegmentCtx:
+    def __init__(self, rec: DispatchRecord, stage: str):
+        self._rec, self._stage = rec, stage
+        self._t0 = 0.0
+
+    def __enter__(self):
+        self._t0 = self._rec.now()
+        return self
+
+    def __exit__(self, *exc):
+        self._rec.add_segment(self._stage, self._t0, self._rec.now())
+        return False
+
+
+class PipelineProfiler:
+    """Bounded ring of dispatch records + the timestamp anchor."""
+
+    def __init__(self, capacity: int = RING_SIZE):
+        self._lock = threading.Lock()
+        self._capacity = capacity
+        self._records: list[DispatchRecord] = []
+        self._next_id = 0
+        # One anchor per profiler: time.time() sampled once against
+        # perf_counter, so timestamps are monotonic (perf_counter) yet
+        # wall-scaled (comparable across same-host ranks).
+        self._anchor = time.time() - time.perf_counter()
+
+    def now(self) -> float:
+        return self._anchor + time.perf_counter()
+
+    def dispatch(self, **meta) -> DispatchRecord:
+        """Open a new dispatch record (ring-bounded)."""
+        with self._lock:
+            rec = DispatchRecord(self, self._next_id, mesh_rank(),
+                                 dict(meta))
+            self._next_id += 1
+            self._records.append(rec)
+            if len(self._records) > self._capacity:
+                del self._records[:len(self._records) - self._capacity]
+            return rec
+
+    def segment_on_last(self, stage: str):
+        """Context manager timing a segment onto the newest record —
+        the seam for work that happens outside the miner (the CLI's
+        periodic checkpoint save). Opens a fresh record when none
+        exists yet."""
+        with self._lock:
+            rec = self._records[-1] if self._records else None
+        if rec is None:
+            rec = self.dispatch(kind=stage)
+        return rec.segment(stage)
+
+    def records(self, tail: int | None = None) -> list[dict]:
+        """Copies of the ringed records; ``tail`` bounds the copy to the
+        newest n BEFORE copying — the shard flusher runs this every
+        second, so it must not deep-copy 4096 records to keep 512."""
+        with self._lock:
+            recs = (self._records if tail is None
+                    else self._records[-tail:])
+            return [dict(r.record, segments=list(r.record["segments"]))
+                    for r in recs]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._records.clear()
+            self._next_id = 0
+
+
+# ---- the process-default profiler ----------------------------------------
+
+_default = PipelineProfiler()
+
+
+def profiler() -> PipelineProfiler:
+    return _default
+
+
+def reset_profiler() -> PipelineProfiler:
+    """Fresh default profiler (test/CLI isolation)."""
+    global _default
+    _default = PipelineProfiler()
+    return _default
+
+
+# ---- interval math --------------------------------------------------------
+
+
+def _union(intervals: list[tuple[float, float]]) -> list[tuple[float, float]]:
+    """Merged, sorted, non-overlapping intervals."""
+    merged: list[list[float]] = []
+    for t0, t1 in sorted(i for i in intervals if i[1] > i[0]):
+        if merged and t0 <= merged[-1][1]:
+            merged[-1][1] = max(merged[-1][1], t1)
+        else:
+            merged.append([t0, t1])
+    return [(a, b) for a, b in merged]
+
+
+def _length(union: list[tuple[float, float]]) -> float:
+    return sum(b - a for a, b in union)
+
+
+def _intersect(a: list[tuple[float, float]],
+               b: list[tuple[float, float]]) -> float:
+    """Total overlap length of two interval unions (two-pointer sweep)."""
+    total, i, j = 0.0, 0, 0
+    while i < len(a) and j < len(b):
+        lo = max(a[i][0], b[j][0])
+        hi = min(a[i][1], b[j][1])
+        if hi > lo:
+            total += hi - lo
+        if a[i][1] < b[j][1]:
+            i += 1
+        else:
+            j += 1
+    return total
+
+
+def _clip(union: list[tuple[float, float]],
+          window: tuple[float, float]) -> float:
+    return _intersect(union, [window])
+
+
+# ---- the report -----------------------------------------------------------
+
+
+def pipeline_report(records: list[dict] | None = None,
+                    max_dispatches: int = 64) -> dict:
+    """Overlap/bubble analysis of a record set (default: the process
+    profiler's). Records spanning several ranks are analyzed PER RANK
+    (each rank has its own device to keep busy) and summarized.
+
+    Per rank: ``wall_s`` (first segment start → last end), per-stage
+    totals, ``device_busy_s`` (union of device windows),
+    ``bubble_fraction`` = 1 − device_busy/wall, ``overlap_s`` =
+    |host ∩ device|, ``host_overlapped_fraction`` = overlap/host_busy.
+    ``dispatches`` lists the newest ``max_dispatches`` with per-dispatch
+    segment seconds and this dispatch's device-window overlap fraction.
+    """
+    if records is None:
+        records = profiler().records()
+    by_rank: dict[int, list[dict]] = {}
+    for r in records:
+        by_rank.setdefault(int(r.get("rank", 0)), []).append(r)
+
+    ranks: dict[str, dict] = {}
+    for rank in sorted(by_rank):
+        recs = by_rank[rank]
+        segs = [s for r in recs for s in r["segments"]]
+        if not segs:
+            continue
+        t_lo = min(s["t0"] for s in segs)
+        t_hi = max(s["t1"] for s in segs)
+        wall = max(t_hi - t_lo, 1e-12)
+        stage_totals = {st: 0.0 for st in STAGES}
+        for s in segs:
+            stage_totals.setdefault(s["stage"], 0.0)
+            stage_totals[s["stage"]] += s["t1"] - s["t0"]
+        device_u = _union([(s["t0"], s["t1"]) for s in segs
+                           if s["stage"] == "device"])
+        host_u = _union([(s["t0"], s["t1"]) for s in segs
+                         if s["stage"] != "device"])
+        device_busy = _length(device_u)
+        host_busy = _length(host_u)
+        overlap = _intersect(device_u, host_u)
+        dispatches = []
+        for r in recs[-max_dispatches:]:
+            d_segs = {s["stage"]: round(s["t1"] - s["t0"], 6)
+                      for s in r["segments"]}
+            windows = [(s["t0"], s["t1"]) for s in r["segments"]
+                       if s["stage"] == "device"]
+            d_dev = _length(_union(windows))
+            d_overlap = sum(_clip(host_u, w) for w in _union(windows))
+            dispatches.append({
+                "dispatch": r["dispatch"],
+                "meta": r.get("meta", {}),
+                "segments_s": d_segs,
+                "device_s": round(d_dev, 6),
+                "overlap_s": round(d_overlap, 6),
+                "overlap_fraction": (round(d_overlap / d_dev, 4)
+                                     if d_dev else 0.0),
+            })
+        ranks[str(rank)] = {
+            "dispatch_count": len(recs),
+            "wall_s": round(wall, 6),
+            "stage_totals_s": {k: round(v, 6)
+                               for k, v in stage_totals.items() if v},
+            "device_busy_s": round(device_busy, 6),
+            "host_busy_s": round(host_busy, 6),
+            "bubble_fraction": round(1.0 - device_busy / wall, 4),
+            "overlap_s": round(overlap, 6),
+            "host_overlapped_fraction": (round(overlap / host_busy, 4)
+                                         if host_busy else 0.0),
+            "dispatches": dispatches,
+        }
+    if not ranks:
+        return {"ranks": {}, "dispatch_count": 0, "bubble_fraction": None,
+                "host_overlapped_fraction": None}
+    n = len(ranks)
+    return {
+        "ranks": ranks,
+        "dispatch_count": sum(v["dispatch_count"] for v in ranks.values()),
+        # Mesh summary: mean over ranks (each rank's device is its own
+        # resource; averaging answers "how idle is a typical chip").
+        "bubble_fraction": round(
+            sum(v["bubble_fraction"] for v in ranks.values()) / n, 4),
+        "host_overlapped_fraction": round(
+            sum(v["host_overlapped_fraction"] for v in ranks.values()) / n,
+            4),
+    }
+
+
+# ---- Perfetto export ------------------------------------------------------
+
+
+def to_chrome_trace(records: list[dict] | None = None) -> dict:
+    """Wall-clock Chrome trace-event JSON: one process row per rank, one
+    thread row per pipeline stage (the forensics exporter's logical-time
+    complement — this one answers "how long", that one answers "in what
+    order").
+
+    Host stages render as complete slices (``ph: X``) — they are
+    sequential on the host thread, so they nest trivially. Device
+    windows render as ASYNC slices (``ph: b``/``e``, id = dispatch):
+    pipelined dispatches overlap partially on the device track, and the
+    trace format only allows sync slices that nest — X events here
+    would make the viewer clamp/drop exactly the overlap this export
+    exists to show.
+    """
+    if records is None:
+        records = profiler().records()
+    segs = [(int(r.get("rank", 0)), r["dispatch"], s)
+            for r in records for s in r["segments"]]
+    events: list[dict] = []
+    if not segs:
+        return {"traceEvents": events, "displayTimeUnit": "ms",
+                "metadata": {"clock": "wall",
+                             "source": "mpi_blockchain_tpu.meshwatch"}}
+    epoch = min(s["t0"] for _, _, s in segs)
+    ranks = sorted({rank for rank, _, _ in segs})
+    for rank in ranks:
+        events.append({"ph": "M", "name": "process_name", "pid": rank,
+                       "tid": 0, "args": {"name": f"rank {rank}"}})
+        for tid, stage in enumerate(STAGES):
+            events.append({"ph": "M", "name": "thread_name", "pid": rank,
+                           "tid": tid, "args": {"name": stage}})
+    tids = {stage: i for i, stage in enumerate(STAGES)}
+    for rank, dispatch, s in segs:
+        stage = s["stage"]
+        ts = round((s["t0"] - epoch) * 1e6, 3)
+        dur = round(max(s["t1"] - s["t0"], 1e-7) * 1e6, 3)
+        tid = tids.get(stage, len(STAGES))
+        if stage == "device":
+            # Async events pair by (cat, id) GLOBALLY — not per pid — so
+            # the id must be rank-unique or rank 0's begin would pair
+            # with rank 1's end (dispatch ids restart at 0 per rank).
+            common = {"cat": "pipeline", "name": "device", "pid": rank,
+                      "tid": tid, "id": f"r{rank}d{dispatch}",
+                      "args": {"dispatch": dispatch}}
+            events.append({**common, "ph": "b", "ts": ts})
+            events.append({**common, "ph": "e", "ts": round(ts + dur, 3)})
+        else:
+            events.append({
+                "ph": "X", "cat": "pipeline", "name": stage,
+                "pid": rank, "tid": tid, "ts": ts, "dur": dur,
+                "args": {"dispatch": dispatch},
+            })
+    return {"traceEvents": events, "displayTimeUnit": "ms",
+            "metadata": {"clock": "wall", "epoch_unix_s": epoch,
+                         "source": "mpi_blockchain_tpu.meshwatch"}}

@@ -31,6 +31,7 @@ import numpy as np
 
 from .. import core
 from ..config import MAX_EXTRA_NONCE, ConfigError, extend_payload
+from ..meshwatch.pipeline import profiler
 from ..telemetry import counter, heartbeat
 from ..telemetry.spans import span
 from ..ops.sha256_jnp import (IV, _bswap32, compress,
@@ -216,34 +217,46 @@ class FusedMiner:
         def dispatch_one():
             nonlocal prev, height, remaining
             k = min(remaining, self.blocks_per_call)
-            payloads = [self.config.payload(height + j + 1)
-                        for j in range(k)]
-            data_words = np.stack([_words_be(core.sha256d(p))
-                                   for p in payloads])
-            with span("fused.dispatch", k=k, height=height):
-                nonces, prev = self._fn(k)(prev, jnp.asarray(data_words),
-                                           np.uint32(height))
+            # Pipeline-profiler record per fused call: `enqueue` covers
+            # input build + the (async) dispatch; the `device` window
+            # opens when the call returns and closes at value
+            # materialization in the drain loop below — the host-visible
+            # in-flight interval whose overlap with the append segments
+            # is the pipelining evidence (docs/perfwatch.md).
+            prec = profiler().dispatch(kind="fused", height=height, k=k)
+            with prec.segment("enqueue"):
+                payloads = [self.config.payload(height + j + 1)
+                            for j in range(k)]
+                data_words = np.stack([_words_be(core.sha256d(p))
+                                       for p in payloads])
+                with span("fused.dispatch", k=k, height=height):
+                    nonces, prev = self._fn(k)(prev,
+                                               jnp.asarray(data_words),
+                                               np.uint32(height))
             counter("device_dispatches_total",
                     help="jit'd multi-round search programs dispatched",
                     backend="tpu-fused").inc()
             # Heartbeat per dispatch: the fused loop's only host-side
             # progress point — /healthz watches the last_set age.
             heartbeat("miner_heartbeat").set(height)
-            batches.append((height, payloads, nonces))
+            batches.append((height, payloads, nonces, prec, prec.now()))
             height += k
             remaining -= k
 
         while remaining > 0 and len(batches) < self.PIPELINE_DEPTH:
             dispatch_one()
         while batches:
-            batch_height, payloads, nonces = batches.pop(0)
+            batch_height, payloads, nonces, prec, t_issue = batches.pop(0)
             nonces = replicated_host_value(nonces)
+            prec.add_segment("device", t_issue, prec.now())
             if remaining > 0:
                 dispatch_one()
             for j, payload in enumerate(payloads):
-                cand = self.node.make_candidate(payload)
-                winner = core.set_nonce(cand, int(nonces[j]))
-                with span("miner.append", height=batch_height + j + 1):
+                with prec.segment("validate"):
+                    cand = self.node.make_candidate(payload)
+                    winner = core.set_nonce(cand, int(nonces[j]))
+                with span("miner.append", height=batch_height + j + 1), \
+                        prec.segment("append"):
                     accepted = self.node.submit(winner)
                 if not accepted:
                     self._recover_block(batch_height + j + 1,

@@ -14,6 +14,7 @@ from typing import Callable
 from .. import core
 from ..backend import MinerBackend, backend_from_config
 from ..config import MAX_EXTRA_NONCE, MinerConfig, extend_payload
+from ..meshwatch.pipeline import profiler
 from ..telemetry import counter, heartbeat, histogram
 from ..telemetry.spans import span
 from ..utils.logging import block_logger
@@ -62,10 +63,18 @@ class Miner:
         tried = 0
         with span("miner.block", height=height):
             for extra_nonce in range(MAX_EXTRA_NONCE + 1):
-                cand = self.node.make_candidate(
-                    extend_payload(data, extra_nonce))
+                # One pipeline-profiler dispatch per sweep: in this
+                # synchronous loop the device window IS the search call,
+                # so the report's bubble fraction directly prices the
+                # host tail between sweeps (docs/perfwatch.md).
+                prec = profiler().dispatch(kind="sweep", height=height,
+                                           backend=backend)
+                with prec.segment("enqueue"):
+                    cand = self.node.make_candidate(
+                        extend_payload(data, extra_nonce))
                 with span("miner.sweep", height=height,
-                          extra_nonce=extra_nonce):
+                          extra_nonce=extra_nonce), \
+                        prec.segment("device"):
                     res = self.backend.search(cand,
                                               self.config.difficulty_bits)
                 counter("mining_rounds_total",
@@ -90,8 +99,10 @@ class Miner:
                     f"{self.config.difficulty_bits} is unsatisfiably high")
             wall_ms = (time.perf_counter() - t0) * 1e3
             res = dataclasses.replace(res, hashes_tried=tried)
-            winner = core.set_nonce(cand, res.nonce)
-            with span("miner.append", height=height):
+            with prec.segment("validate"):
+                winner = core.set_nonce(cand, res.nonce)
+            with span("miner.append", height=height), \
+                    prec.segment("append"):
                 accepted = self.node.submit(winner)
         if not accepted:
             raise RuntimeError(f"backend returned invalid block at {height}")

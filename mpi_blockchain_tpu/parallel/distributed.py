@@ -35,6 +35,15 @@ def init_distributed(coordinator_address: str | None = None,
     jax.distributed.initialize(coordinator_address=coordinator_address,
                                num_processes=num_processes,
                                process_id=process_id)
+    # The authoritative rank stamp: every rank-labeled metric and every
+    # meshwatch shard this process writes from here on carries the real
+    # process index, not a launcher-guessed one — including the shard
+    # FILE identity (an auto-detected launch armed the writer as rank 0
+    # on every host; rebind moves each to its real rank_NNNN.json).
+    from ..meshwatch.shard import rebind_installed
+    from ..telemetry import set_mesh_rank
+    set_mesh_rank(jax.process_index())
+    rebind_installed(jax.process_index(), jax.process_count())
 
 
 def make_global_miner_mesh() -> jax.sharding.Mesh:
@@ -44,7 +53,11 @@ def make_global_miner_mesh() -> jax.sharding.Mesh:
     each host runs the same sharded sweep and XLA keeps the winner-select
     collective consistent across DCN.
     """
-    return jax.make_mesh((len(jax.devices()),), ("miners",))
+    from .mesh import record_mesh_topology
+
+    mesh = jax.make_mesh((len(jax.devices()),), ("miners",))
+    record_mesh_topology(mesh, local_devices=len(jax.local_devices()))
+    return mesh
 
 
 def world_info() -> dict:

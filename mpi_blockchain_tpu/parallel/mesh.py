@@ -81,6 +81,22 @@ def replicated_host_values(xs) -> tuple:
     return tuple(replicated_host_value(x) for x in xs)
 
 
+def record_mesh_topology(mesh: Mesh, local_devices: int | None = None
+                         ) -> None:
+    """Host-side topology gauges, stamped whenever a miners mesh is
+    built: the mesh-wide device count (replicated, unlabeled) and this
+    process's share under its ``rank`` label — the meshwatch aggregator
+    reads the latter per-rank, so an 8-rank merge shows exactly which
+    rank brought how many chips (docs/observability.md §Mesh shards)."""
+    from ..telemetry import gauge, rank_gauge
+
+    gauge("mesh_devices", help="devices in the ('miners',) mesh").set(
+        mesh.size)
+    rank_gauge("mesh_rank_local_devices",
+               help="devices this rank contributes to the mesh").set(
+        local_devices if local_devices is not None else mesh.size)
+
+
 def make_miner_mesh(n_miners: int) -> Mesh:
     """A 1-D ('miners',) mesh over the first n_miners local devices."""
     devices = jax.devices()
@@ -89,8 +105,10 @@ def make_miner_mesh(n_miners: int) -> Mesh:
             f"need {n_miners} devices for the miners mesh, have "
             f"{len(devices)} (tests: XLA_FLAGS="
             f"--xla_force_host_platform_device_count={n_miners})")
-    return jax.make_mesh((n_miners,), ("miners",),
+    mesh = jax.make_mesh((n_miners,), ("miners",),
                          devices=devices[:n_miners])
+    record_mesh_topology(mesh)
+    return mesh
 
 
 def maybe_shard_over_miners(fn, n_miners: int, mesh: Mesh | None,

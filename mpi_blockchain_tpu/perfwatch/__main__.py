@@ -31,7 +31,7 @@ import json
 import pathlib
 import sys
 
-from .attribution import attribute_spans, utilization
+from .attribution import attribute_pipeline, attribute_spans, utilization
 from .detector import (DEFAULT_SPREAD_K, DEFAULT_THRESHOLD_PCT,
                        check_candidate, check_history, regressions)
 from .history import (DEFAULT_HISTORY_NAME, HistoryStore,
@@ -142,6 +142,19 @@ def cmd_report(args) -> int:
         report["roofline"] = utilization(
             sweeps[-1].payload["hashes_per_sec_per_chip"],
             int(census[-1].payload["alu_ops_per_nonce"]))
+    # Dispatch pipeline overlap/bubble. The report CLI is its own
+    # process, so its in-process profiler is empty — the records of a
+    # finished run come from its --mesh-obs shards (--mesh-dir); the
+    # in-process path serves embedded callers. Only a non-empty record
+    # set is reported (an empty row would read as "no bubble").
+    records = None
+    if args.mesh_dir:
+        from ..meshwatch.aggregate import read_shards
+        records = [r for s in read_shards(args.mesh_dir)
+                   for r in s.get("pipeline") or []]
+    pipeline = attribute_pipeline(records)
+    if pipeline["dispatch_count"]:
+        report["pipeline"] = pipeline
     print(json.dumps(report, sort_keys=True))
     return 0
 
@@ -287,6 +300,12 @@ def main(argv: list[str] | None = None) -> int:
     p_rep.add_argument("--threshold-pct", type=float,
                        default=DEFAULT_THRESHOLD_PCT)
     p_rep.add_argument("--k", type=float, default=DEFAULT_SPREAD_K)
+    p_rep.add_argument("--mesh-dir", metavar="DIR", default=None,
+                       help="read dispatch pipeline records from this "
+                            "--mesh-obs shard directory (the report CLI "
+                            "is its own process, so overlap/bubble "
+                            "numbers of a finished run live in its "
+                            "shards)")
     p_rep.set_defaults(fn=cmd_report)
 
     p_srv = sub.add_parser("serve", help="standalone metrics endpoint "

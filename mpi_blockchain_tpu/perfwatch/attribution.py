@@ -107,3 +107,24 @@ def attribute_spans(registry=None) -> dict:
                 if buckets else None)
     return {"buckets": buckets, "total_s": round(total, 6),
             "dominant": dominant}
+
+
+# ---- dispatch pipeline attribution ---------------------------------------
+
+
+def attribute_pipeline(records: list[dict] | None = None) -> dict:
+    """The third attribution axis: not how much time each layer ate
+    (``attribute_spans``) but whether host and device time OVERLAPPED.
+
+    Delegates to the meshwatch dispatch profiler
+    (``meshwatch.pipeline.pipeline_report``) and returns its report:
+    per-rank stage totals, per-dispatch segment seconds, ``overlap_s``
+    (host work hidden behind an in-flight dispatch) and
+    ``bubble_fraction`` (wall-clock share with the device idle — the
+    number the async-dispatch roadmap item must drive to ~0). Empty
+    when no dispatch has been profiled in this process; ``meshwatch
+    report --dir`` computes the same thing from shards post-hoc.
+    """
+    from ..meshwatch.pipeline import pipeline_report
+
+    return pipeline_report(records)

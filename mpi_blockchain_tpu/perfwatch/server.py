@@ -18,10 +18,18 @@ Endpoints (catalogue: docs/perfwatch.md):
   has not elapsed since server start, and flips to 503 when progress
   stalls — a wedged device init (heartbeat stamped at phase entry, then
   silence) and a stalled sim both trip it.
-* ``/events``   — the newest ``?n=`` (default 64) records of the bounded
-  JSON event ring, **redacted**: values under path/argv/env-like keys
-  are masked and long strings truncated, so an operator-facing scrape
-  of a shared box never leaks filesystem layout or command lines.
+* ``/events``   — records of the bounded JSON event ring, **redacted**:
+  values under path/argv/env-like keys are masked and long strings
+  truncated, so an operator-facing scrape of a shared box never leaks
+  filesystem layout or command lines. Every record carries a monotonic
+  ``seq``; ``?n=`` (default 64) tails the newest n, and ``?since=SEQ``
+  returns only records newer than the cursor — a poller resumes where
+  it left off instead of re-reading and deduping the tail. With
+  ``since``, ``n`` defaults to unbounded and an explicit ``n`` pages
+  OLDEST-first (the poller advances its cursor past what it received,
+  so pagination is lossless; newest-first would skip the middle of a
+  burst forever). The ring bound still applies, so a poller slower
+  than the ring loses the overwritten records.
 
 Shutdown: ``close()`` stops the accept loop and closes the socket;
 request handler threads are daemonic so an in-flight scrape cannot hold
@@ -40,7 +48,7 @@ import time
 import urllib.parse
 
 from ..telemetry import default_registry
-from ..telemetry.events import env_number, recent_events
+from ..telemetry.events import env_number, recent_with_seq
 
 # Default last-progress stall budget (seconds) before /healthz flips
 # unhealthy. Generous: a legitimate big-batch TPU dispatch can hold the
@@ -84,7 +92,21 @@ def active_server() -> "MetricsServer | None":
 
 class MetricsServer:
     """The threaded endpoint. ``port=0`` binds an ephemeral port;
-    ``start()`` returns the actual one."""
+    ``start()`` returns the actual one.
+
+    The lifecycle scaffolding (bind, daemon serve thread, idempotent
+    close, ``_send`` hardening) is the ONE copy other endpoints build
+    on: meshwatch's MeshServer subclasses this with its own
+    ``handler_cls`` and opts out of the active-server registry
+    (``register_active``), so hardening fixes here propagate.
+    """
+
+    #: The request handler class; subclasses override with their own
+    #: ``_Handler`` subclass to serve different routes.
+    handler_cls: type["_Handler"]
+    #: Whether start()/close() maintain the process-wide active-server
+    #: list (the CLI announce / test-discovery mechanism).
+    register_active = True
 
     def __init__(self, port: int = 0, host: str = "127.0.0.1",
                  stall_s: float | None = None, registry=None):
@@ -105,7 +127,7 @@ class MetricsServer:
         """Bind + serve on a daemon thread; returns the bound port."""
         outer = self
 
-        class Handler(_Handler):
+        class Handler(self.handler_cls):
             server_ctx = outer
 
         self._httpd = http.server.ThreadingHTTPServer(
@@ -115,10 +137,11 @@ class MetricsServer:
         self._started_at = time.monotonic()
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, kwargs={"poll_interval": 0.1},
-            name=f"perfwatch-metrics-{self.port}", daemon=True)
+            name=f"{type(self).__name__}-{self.port}", daemon=True)
         self._thread.start()
-        with _active_lock:
-            _active.append(self)
+        if self.register_active:
+            with _active_lock:
+                _active.append(self)
         return self.port
 
     def close(self) -> None:
@@ -153,17 +176,12 @@ class MetricsServer:
         503 otherwise — with per-heartbeat detail so the stalled layer
         is named, not guessed.
         """
-        beats: dict[str, dict] = {}
-        freshest: float | None = None
-        for m in self.registry().metrics():
-            if m.kind != "gauge" or not m.name.endswith(HEARTBEAT_SUFFIX):
-                continue
-            age = m.age_s()
-            label = m.name + "".join(f"{{{k}={v}}}" for k, v in m.labels)
-            beats[label] = {"value": m.value,
-                            "age_s": None if age is None else round(age, 3)}
-            if age is not None and (freshest is None or age < freshest):
-                freshest = age
+        from ..telemetry import heartbeat_snapshot
+
+        beats = heartbeat_snapshot(self.registry())
+        ages = [b["age_s"] for b in beats.values()
+                if b["age_s"] is not None]
+        freshest = min(ages) if ages else None
         uptime = (time.monotonic() - self._started_at
                   if self._started_at is not None else 0.0)
         if freshest is not None and freshest <= self.stall_s:
@@ -184,8 +202,11 @@ class MetricsServer:
             "heartbeats": beats,
         }
 
-    def events_tail(self, n: int) -> list[dict]:
-        return [redact_event(r) for r in recent_events(n)]
+    def events_tail(self, n: int | None,
+                    since: int | None = None) -> list[dict]:
+        """Redacted ring records, each stamped with its cursor seq."""
+        return [{**redact_event(r), "seq": s}
+                for s, r in recent_with_seq(n=n, since=since)]
 
 
 class _Handler(http.server.BaseHTTPRequestHandler):
@@ -218,12 +239,24 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                        "application/json")
         elif parsed.path == "/events":
             q = urllib.parse.parse_qs(parsed.query)
-            try:
-                n = max(1, int(q.get("n", ["64"])[0]))
-            except ValueError:
-                n = 64
+            since = None
+            if "since" in q:
+                try:
+                    since = max(0, int(q["since"][0]))
+                except ValueError:
+                    since = None
+            # With a cursor, the default is "everything newer" (the
+            # whole point of since is not losing records to a tail
+            # bound); an explicit n pages oldest-first (lossless —
+            # recent_with_seq documents why).
+            n: int | None = None if since is not None else 64
+            if "n" in q:
+                try:
+                    n = max(1, int(q["n"][0]))
+                except ValueError:
+                    pass
             body = "\n".join(json.dumps(r, sort_keys=True, default=str)
-                             for r in ctx.events_tail(n))
+                             for r in ctx.events_tail(n, since=since))
             self._send(200, body + ("\n" if body else ""),
                        "application/json")
         else:
@@ -231,6 +264,10 @@ class _Handler(http.server.BaseHTTPRequestHandler):
                 "error": f"unknown path {parsed.path!r}",
                 "endpoints": ["/metrics", "/healthz", "/events"]}) + "\n",
                 "application/json")
+
+
+# Defined after _Handler exists; subclass servers override this.
+MetricsServer.handler_cls = _Handler
 
 
 def wait_listening(host: str, port: int, timeout_s: float = 5.0) -> bool:

@@ -47,6 +47,55 @@ def counter(name: str, help: str = "", **labels) -> Counter:
     return default_registry().counter(name, help=help, **labels)
 
 
+# ---- mesh-rank context ----------------------------------------------------
+# One rank id per process, stamped by whoever knows it first (the CLI's
+# --mesh-obs arming, parallel/distributed.py after init). Multi-rank code
+# paths must label per-rank metrics through the rank_* helpers below so
+# the `rank` label is one convention, never hand-rolled — chainlint rule
+# TEL003 enforces this over parallel/, meshwatch/, and the multiprocess
+# experiments.
+
+_mesh_rank: int = 0
+
+
+def set_mesh_rank(rank: int) -> None:
+    """Declare this process's mesh rank (0-based); the rank_* helpers
+    default their ``rank`` label to it."""
+    global _mesh_rank
+    _mesh_rank = int(rank)
+
+
+def mesh_rank() -> int:
+    return _mesh_rank
+
+
+def _with_rank(labels: dict, rank: int | None) -> dict:
+    labels = dict(labels)
+    labels["rank"] = str(rank if rank is not None else mesh_rank())
+    return labels
+
+
+def rank_counter(name: str, help: str = "", rank: int | None = None,
+                 **labels) -> Counter:
+    """A counter labeled with the mesh rank (this process's by default)."""
+    return default_registry().counter(name, help=help,
+                                      **_with_rank(labels, rank))
+
+
+def rank_gauge(name: str, help: str = "", rank: int | None = None,
+               **labels) -> Gauge:
+    """A gauge labeled with the mesh rank (this process's by default)."""
+    return default_registry().gauge(name, help=help,
+                                    **_with_rank(labels, rank))
+
+
+def rank_histogram(name: str, help: str = "", rank: int | None = None,
+                   **labels) -> Histogram:
+    """A histogram labeled with the mesh rank (this process's by default)."""
+    return default_registry().histogram(name, help=help,
+                                        **_with_rank(labels, rank))
+
+
 def gauge(name: str, help: str = "", **labels) -> Gauge:
     """Get-or-create a gauge on the default registry."""
     return default_registry().gauge(name, help=help, **labels)
@@ -70,6 +119,25 @@ def heartbeat(name: str) -> Gauge:
 def histogram(name: str, help: str = "", **labels) -> Histogram:
     """Get-or-create a histogram on the default registry."""
     return default_registry().histogram(name, help=help, **labels)
+
+
+def heartbeat_snapshot(registry: Registry | None = None) -> dict:
+    """Every ``*_heartbeat`` gauge as {label-key: {"value", "age_s"}}.
+
+    The ONE copy of the heartbeat key format (``name{k=v}...``) and
+    value shape — perfwatch's ``/healthz`` and meshwatch's shards both
+    read progress through this, so the per-process and mesh surfaces
+    can never drift apart in how they spell a heartbeat."""
+    reg = registry if registry is not None else default_registry()
+    beats: dict[str, dict] = {}
+    for m in reg.metrics():
+        if m.kind != "gauge" or not m.name.endswith("_heartbeat"):
+            continue
+        age = m.age_s()
+        label = m.name + "".join(f"{{{k}={v}}}" for k, v in m.labels)
+        beats[label] = {"value": m.value,
+                        "age_s": None if age is None else round(age, 3)}
+    return beats
 
 
 def render_prometheus() -> str:

@@ -49,16 +49,24 @@ def env_number(name: str, default, cast=int, minimum=1):
 # truncates very long runs, so the cap is operator-tunable.
 EVENT_RING_SIZE = env_number("MPIBT_EVENT_BUFFER", _DEFAULT_RING_SIZE)
 
+# The ring holds (seq, record) pairs: seq is a process-lifetime monotonic
+# cursor (never reset, not even by clear_events) so a /events?since=SEQ
+# poller can resume tail-reading without re-fetching and deduping — the
+# record dicts themselves stay seq-free, keeping dump/replay byte
+# contracts untouched.
 _ring: collections.deque = collections.deque(maxlen=EVENT_RING_SIZE)
 _lock = threading.Lock()
+_seq = 0
 
 
 def emit_event(record: dict) -> None:
     """Emits one structured event as a JSON line (INFO) + rings it."""
     from ..utils.logging import get_logger
 
+    global _seq
     with _lock:
-        _ring.append(dict(record))
+        _seq += 1
+        _ring.append((_seq, dict(record)))
     get_logger().info(json.dumps(record, sort_keys=True, default=str))
 
 
@@ -66,15 +74,39 @@ def recent_events(n: int | None = None,
                   event: str | None = None) -> list[dict]:
     """The last n ringed events (all by default), newest last; ``event``
     filters on the record's "event" field."""
+    return [r for _, r in recent_with_seq(n=n, event=event)]
+
+
+def recent_with_seq(n: int | None = None, since: int | None = None,
+                    event: str | None = None) -> list[tuple[int, dict]]:
+    """Like ``recent_events`` but each record is paired with its monotonic
+    seq; ``since`` keeps only records with ``seq > since`` (the cursor
+    contract of perfwatch's ``/events?since=``). ``n`` bounds the reply:
+    the newest n in tail mode, but the OLDEST n when a cursor is given —
+    a paging poller advances its cursor past what it received, so
+    oldest-first pagination is lossless while newest-first would skip
+    the burst between cursor and tail forever. Records older than the
+    ring bound are gone regardless — pollers slower than
+    ``MPIBT_EVENT_BUFFER`` events per poll lose the overwritten tail."""
     with _lock:
         out = list(_ring)
+    if since is not None:
+        out = [(s, r) for s, r in out if s > since]
     if event is not None:
-        out = [r for r in out if r.get("event") == event]
+        out = [(s, r) for s, r in out if r.get("event") == event]
     if n is not None:
-        out = out[-n:]
+        out = out[:n] if since is not None else out[-n:]
     return out
 
 
+def latest_seq() -> int:
+    """The seq of the newest emitted event (0 before any)."""
+    with _lock:
+        return _seq
+
+
 def clear_events() -> None:
+    """Empties the ring; the seq cursor keeps counting (a poller's
+    ``since`` stays valid across a clear)."""
     with _lock:
         _ring.clear()

@@ -180,7 +180,9 @@ class Histogram(_Metric):
 
     kind = "histogram"
     RESERVOIR_SIZE = 1024
-    QUANTILES = (0.5, 0.9, 0.99)
+    # p50/p95/p99: count/sum alone hide tail latency, and p95 (not p90)
+    # is the tail bound the pipeline/serving roadmap items are judged on.
+    QUANTILES = (0.5, 0.95, 0.99)
 
     def __init__(self, name, help="", labels=(),
                  reservoir_size: int | None = None):

@@ -334,6 +334,95 @@ def test_tel002_live_tree_clean():
     assert findings == [], "\n".join(f.render() for f in findings)
 
 
+# ---- TEL003: rank-label discipline in multi-rank code ------------------
+
+
+RANK_METRICS = textwrap.dedent("""\
+    from mpi_blockchain_tpu.telemetry import (counter, gauge, histogram,
+                                              rank_counter, rank_gauge)
+
+
+    def instrument(rank):
+        counter("shard_hashes_total", rank=rank).inc()    # hand-rolled
+        gauge("shard_height", rank=str(rank)).set(1)      # hand-rolled
+        histogram("shard_lat_ms", rank=0).observe(1.0)    # hand-rolled
+        rank_counter("ok_hashes_total").inc()             # the helper
+        rank_gauge("ok_height", rank=rank).set(1)         # helper + rank
+        counter("plain_total", backend="cpu").inc()       # no rank label
+    """)
+
+
+def test_tel003_hand_rolled_rank_label_fires(tmp_path):
+    from mpi_blockchain_tpu.analysis.telemetry_lint import run_telemetry_lint
+
+    bad = tmp_path / "rank_metrics.py"
+    bad.write_text(RANK_METRICS)
+    findings = run_telemetry_lint(
+        ROOT, overrides={"rank_scope_files": [bad],
+                         "telemetry_files": []})
+    assert rule_set(findings) == {"TEL003"}
+    assert len(findings) == 3
+    assert all("rank_" in f.message for f in findings)
+
+
+def test_tel003_out_of_scope_file_not_checked(tmp_path):
+    """The same hand-rolled label outside the multi-rank scope is the
+    call site's business — only the scoped file set is linted."""
+    from mpi_blockchain_tpu.analysis.telemetry_lint import run_telemetry_lint
+
+    bad = tmp_path / "rank_metrics.py"
+    bad.write_text(RANK_METRICS)
+    findings = run_telemetry_lint(
+        ROOT, overrides={"rank_scope_files": [],
+                         "telemetry_files": [bad]})
+    assert "TEL003" not in rule_set(findings)
+
+
+def test_tel003_inline_suppression(tmp_path):
+    suppressed = RANK_METRICS.replace(
+        'counter("shard_hashes_total", rank=rank).inc()    # hand-rolled',
+        'counter("shard_hashes_total", rank=rank).inc()  '
+        '# chainlint: disable=TEL003')
+    bad = tmp_path / "rank_metrics.py"
+    bad.write_text(suppressed)
+    findings = run_all(root=tmp_path, passes=["telemetry"],
+                       overrides={"rank_scope_files": [bad],
+                                  "telemetry_files": [],
+                                  "sim_py": SIM_PY})
+    assert len([f for f in findings if f.rule == "TEL003"]) == 2
+
+
+def test_tel003_live_tree_clean():
+    """parallel/, meshwatch/, bench_lib and the multiprocess experiments
+    all go through the rank-aware helpers."""
+    from mpi_blockchain_tpu.analysis.telemetry_lint import (
+        _rank_scope_files, run_telemetry_lint)
+
+    # The live scope must actually cover the multi-rank surfaces.
+    rels = {str(p.relative_to(ROOT)) for p in _rank_scope_files(ROOT)}
+    for expected in ("mpi_blockchain_tpu/parallel/mesh.py",
+                     "mpi_blockchain_tpu/meshwatch/shard.py",
+                     "mpi_blockchain_tpu/bench_lib.py",
+                     "experiments/multiprocess_world.py",
+                     "experiments/v5e8_launch.py"):
+        assert expected in rels, expected
+    findings = [f for f in run_telemetry_lint(ROOT)
+                if f.rule == "TEL003"]
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_tel003_cli_pass_family(tmp_path):
+    bad = tmp_path / "rank_metrics.py"
+    bad.write_text(RANK_METRICS)
+    proc = subprocess.run(
+        [sys.executable, "-m", "mpi_blockchain_tpu.analysis",
+         "--passes", "telemetry", "--override",
+         f"rank_scope_files={bad}"],
+        cwd=ROOT, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "TEL003" in proc.stdout
+
+
 def test_tel002_cli_pass_family(tmp_path):
     bad = tmp_path / "bad_metrics.py"
     bad.write_text(BAD_METRICS)

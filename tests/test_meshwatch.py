@@ -1,0 +1,781 @@
+"""meshwatch subsystem tests (mpi_blockchain_tpu/meshwatch).
+
+Covers the per-rank shard writer (atomic writes, flusher, final-shard
+semantics), the mesh aggregator (counters summed, gauges/histograms
+per-rank, stale/missing/finished rank detection + the mesh_rank_stale
+event), the dispatch pipeline profiler (interval math against
+hand-computed fixtures, miner integration, Perfetto export with one
+track per rank and stage), the merge/report/watch CLI, the MeshServer
+endpoints, and the ISSUE acceptance shape: multi-rank virtual-cpu runs
+with --mesh-obs where a SIGKILL'd rank shows up as stale — and ONLY it
+— in the merged view.
+"""
+import json
+import os
+import pathlib
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+from mpi_blockchain_tpu import telemetry
+from mpi_blockchain_tpu.meshwatch import aggregate, pipeline
+from mpi_blockchain_tpu.meshwatch.aggregate import (
+    merge_shards, mesh_health, read_shards, render_mesh_prometheus)
+from mpi_blockchain_tpu.meshwatch.pipeline import (
+    PipelineProfiler, pipeline_report, profiler, reset_profiler,
+    to_chrome_trace)
+from mpi_blockchain_tpu.meshwatch.shard import ShardWriter, shard_path
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(autouse=True)
+def fresh_state():
+    telemetry.reset()
+    telemetry.clear_events()
+    telemetry.set_mesh_rank(0)
+    reset_profiler()
+    aggregate._stale_announced.clear()
+    yield
+    telemetry.reset()
+    telemetry.clear_events()
+    telemetry.set_mesh_rank(0)
+    reset_profiler()
+    aggregate._stale_announced.clear()
+
+
+# ---- shard writer ------------------------------------------------------
+
+
+def test_shard_write_roundtrip_and_atomicity(tmp_path):
+    telemetry.counter("hashes_tried_total", backend="cpu").inc(42)
+    telemetry.heartbeat("miner_heartbeat").set(7)
+    w = ShardWriter(tmp_path, rank=3, world_size=8)
+    path = w.write()
+    assert path == shard_path(tmp_path, 3)
+    shard = json.loads(path.read_text())
+    assert shard["rank"] == 3 and shard["world_size"] == 8
+    assert shard["final"] is False and shard["seq"] == 1
+    assert shard["registry"]["hashes_tried_total"][0]["value"] == 42
+    assert "miner_heartbeat" in shard["heartbeats"]
+    assert shard["heartbeats"]["miner_heartbeat"]["value"] == 7
+    # Atomic writes leave no tmp files behind.
+    assert [p.name for p in tmp_path.iterdir()] == [path.name]
+
+
+def test_shard_flusher_and_final_close(tmp_path):
+    w = ShardWriter(tmp_path, rank=0, interval_s=0.05)
+    w.start()
+    time.sleep(0.2)
+    w.close(status=0)
+    shard = json.loads(shard_path(tmp_path, 0).read_text())
+    assert shard["final"] is True and shard["exit_status"] == 0
+    assert shard["seq"] >= 3      # start + >=1 flusher tick + final
+    w.close(status=0)             # idempotent
+
+
+def test_shard_abort_stops_flusher_without_final_write(tmp_path):
+    """Failure paths in live processes: abort() freezes the shard
+    non-final so it ages into staleness — it is NOT refreshed forever
+    by a leaked flusher and NOT stamped finished."""
+    w = ShardWriter(tmp_path, rank=0, interval_s=0.05)
+    w.start()
+    time.sleep(0.12)
+    w.abort()
+    shard = json.loads(shard_path(tmp_path, 0).read_text())
+    assert shard["final"] is False
+    seq = shard["seq"]
+    time.sleep(0.15)    # a leaked flusher would have re-written by now
+    assert json.loads(shard_path(tmp_path, 0).read_text())["seq"] == seq
+    code, health = mesh_health(tmp_path, stall_s=0.05)
+    assert code == 503 and health["stale_ranks"] == [0]
+
+
+def test_install_failure_leaves_nothing_armed(tmp_path):
+    """A failed install must not leave a broken writer behind: a later
+    rebind_installed (called from inside distributed init!) and
+    uninstall must be clean no-ops, not re-raised FS errors."""
+    from mpi_blockchain_tpu.meshwatch import shard as shard_mod
+
+    blocker = tmp_path / "blocked"
+    blocker.write_text("a file where the shard DIR should go")
+    with pytest.raises(OSError):
+        shard_mod.install(blocker / "mesh", rank=0)
+    assert shard_mod.installed() is None
+    shard_mod.rebind_installed(3, 8)        # must not raise
+    shard_mod.uninstall(status=0)           # must not raise
+
+
+def test_rebind_tolerates_transient_fs_error(tmp_path):
+    """rebind runs inside distributed init; like the flusher loop it
+    must swallow an OSError (the next flush tick corrects the shard)."""
+    from mpi_blockchain_tpu.meshwatch import shard as shard_mod
+
+    w = shard_mod.install(tmp_path / "mesh", rank=0, interval_s=60)
+    try:
+        w.directory = tmp_path / "blocked2"
+        (tmp_path / "blocked2").write_text("file blocks the dir")
+        w.rebind(5, 8)                      # write fails -> tolerated
+        assert w.rank == 5 and w.world_size == 8
+    finally:
+        w.directory = tmp_path / "mesh"
+        shard_mod.uninstall(status=0)
+
+
+def test_perfwatch_report_pipeline_from_mesh_dir(tmp_path, capsys):
+    """`perfwatch report --mesh-dir` reads a finished run's pipeline
+    records out of its shards — the report CLI's own profiler is empty
+    by construction (it is a separate process)."""
+    from mpi_blockchain_tpu.perfwatch.__main__ import main as pw_main
+
+    rec = profiler().dispatch(kind="sweep")
+    rec.add_segment("device", 1.0, 3.0)
+    rec.add_segment("append", 3.0, 3.5)
+    obs = tmp_path / "mesh"
+    ShardWriter(obs, rank=0).write(final=True, status=0)
+    reset_profiler()    # the "separate process" shape: empty profiler
+    hist = tmp_path / "hist.jsonl"
+    hist.write_text("")
+    assert pw_main(["report", "--history", str(hist)]) == 0
+    assert "pipeline" not in json.loads(capsys.readouterr().out)
+    assert pw_main(["report", "--history", str(hist),
+                    "--mesh-dir", str(obs)]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["pipeline"]["dispatch_count"] == 1
+    assert out["pipeline"]["ranks"]["0"]["bubble_fraction"] == 0.2
+
+
+def test_install_uninstall_stamps_exit_status(tmp_path):
+    from mpi_blockchain_tpu.meshwatch import shard as shard_mod
+
+    shard_mod.install(tmp_path, rank=1, world_size=2, interval_s=5)
+    assert shard_mod.installed() is not None
+    shard_mod.uninstall(status=2)
+    assert shard_mod.installed() is None
+    shard = json.loads(shard_path(tmp_path, 1).read_text())
+    assert shard["final"] is True and shard["exit_status"] == 2
+
+
+def test_shard_carries_pipeline_and_event_tails(tmp_path):
+    telemetry.emit_event({"event": "mw_tail", "n": 1})
+    rec = profiler().dispatch(kind="sweep")
+    rec.add_segment("device", 1.0, 2.0)
+    shard = ShardWriter(tmp_path, rank=1).payload()
+    assert any(e.get("event") == "mw_tail" and "seq" in e
+               for e in shard["events_tail"])
+    assert shard["pipeline"][0]["segments"] == [
+        {"stage": "device", "t0": 1.0, "t1": 2.0}]
+    assert shard["pipeline"][0]["rank"] == 0    # profiler-stamped
+
+
+# ---- aggregation -------------------------------------------------------
+
+
+def _shard(rank, counters=None, gauges=None, final=True, age_s=0.0,
+           world=None, heartbeats=None, written_at=None):
+    registry = {}
+    for name, (labels, value) in (counters or {}).items():
+        registry.setdefault(name, []).append(
+            {"kind": "counter", "labels": labels, "value": value})
+    for name, (labels, value) in (gauges or {}).items():
+        registry.setdefault(name, []).append(
+            {"kind": "gauge", "labels": labels, "value": value,
+             "age_s": 0.1})
+    return {"version": 1, "rank": rank,
+            "world_size": world if world is not None else 2,
+            "pid": 123, "seq": 5, "final": final,
+            "written_at": (written_at if written_at is not None
+                           else time.time() - age_s),
+            "heartbeats": heartbeats or {}, "registry": registry,
+            "events_tail": [], "causal_tail": {}, "pipeline": []}
+
+
+def test_merge_sums_counters_and_keeps_gauges_per_rank():
+    shards = [
+        _shard(0, counters={"hashes_tried_total": ({"backend": "cpu"}, 10)},
+               gauges={"chain_height": ({}, 4)}),
+        _shard(1, counters={"hashes_tried_total": ({"backend": "cpu"}, 32)},
+               gauges={"chain_height": ({}, 6)}),
+    ]
+    view = merge_shards(shards)
+    (key, c), = view["counters"].items()
+    assert c["name"] == "hashes_tried_total"
+    assert c["total"] == 42
+    assert c["by_rank"] == {"0": 10, "1": 32}
+    (gkey, g), = view["gauges"].items()
+    assert g["by_rank"]["0"]["value"] == 4
+    assert g["by_rank"]["1"]["value"] == 6
+
+
+def test_merge_separates_distinct_labelsets():
+    shards = [
+        _shard(0, counters={"hashes_tried_total": ({"backend": "cpu"}, 5)}),
+        _shard(1, counters={"hashes_tried_total": ({"backend": "tpu"}, 7)}),
+    ]
+    view = merge_shards(shards)
+    totals = {k: v["total"] for k, v in view["counters"].items()}
+    assert totals == {"hashes_tried_total{backend=cpu}": 5,
+                      "hashes_tried_total{backend=tpu}": 7}
+
+
+def test_read_shards_skips_malformed(tmp_path):
+    shard_path(tmp_path, 0).parent.mkdir(parents=True, exist_ok=True)
+    shard_path(tmp_path, 0).write_text(json.dumps(_shard(0)))
+    shard_path(tmp_path, 1).write_text("{torn")
+    shard_path(tmp_path, 2).write_text(json.dumps({"no": "rank"}))
+    shard_path(tmp_path, 3).write_text(json.dumps({"rank": None}))
+    shard_path(tmp_path, 4).write_text(json.dumps({"rank": "x"}))
+    shards = read_shards(tmp_path)
+    assert [s["rank"] for s in shards] == [0]
+
+
+def test_mesh_health_all_fresh_ok(tmp_path):
+    code, health = mesh_health(
+        tmp_path, stall_s=5.0,
+        shards=[_shard(0, final=False), _shard(1, final=False)])
+    assert code == 200 and health["status"] == "ok"
+    assert health["live_ranks"] == 2
+    assert health["stale_ranks"] == [] and health["missing_ranks"] == []
+
+
+def test_mesh_health_names_exactly_the_stale_rank(tmp_path):
+    shards = [_shard(0, final=True, age_s=100),     # finished: never stale
+              _shard(1, final=False, age_s=100),    # dead
+              _shard(2, final=False, age_s=0, world=3)]
+    code, health = mesh_health(tmp_path, stall_s=5.0, shards=shards)
+    assert code == 503 and health["status"] == "degraded"
+    assert health["stale_ranks"] == [1]
+    assert health["ranks"]["0"]["status"] == "finished"
+    assert health["ranks"]["2"]["status"] == "ok"
+    # One mesh_rank_stale event per TRANSITION, not per scrape.
+    mesh_health(tmp_path, stall_s=5.0, shards=shards)
+    events = telemetry.recent_events(event="mesh_rank_stale")
+    assert len(events) == 1 and events[0]["rank"] == 1
+    assert telemetry.gauge("mesh_live_ranks").value == 1
+
+
+def test_mesh_health_failed_rank_never_reads_finished(tmp_path):
+    """A final shard with a nonzero exit status is `failed` (503, named,
+    mesh_rank_failed event once) — a rank that exited rc 2 must not be
+    reported as cleanly done."""
+    shards = [_shard(0, final=True), dict(_shard(1, final=True),
+                                          exit_status=2)]
+    code, health = mesh_health(tmp_path, stall_s=5.0, shards=shards)
+    assert code == 503
+    assert health["failed_ranks"] == [1] and health["stale_ranks"] == []
+    assert health["ranks"]["0"]["status"] == "finished"
+    assert health["ranks"]["1"]["status"] == "failed"
+    assert health["ranks"]["1"]["exit_status"] == 2
+    mesh_health(tmp_path, stall_s=5.0, shards=shards)   # no re-announce
+    events = telemetry.recent_events(event="mesh_rank_failed")
+    assert len(events) == 1 and events[0]["rank"] == 1
+
+
+def test_mesh_health_wedged_rank_with_live_flusher_is_stale(tmp_path):
+    """The shard flusher is a daemon thread that survives a wedged
+    miner, so a straggler's shard stays FRESH — staleness must also
+    fire on the heartbeat age carried inside the shard."""
+    wedged = _shard(1, final=False, age_s=0.0,
+                    heartbeats={"miner_heartbeat": {"value": 4,
+                                                    "age_s": 120.0}})
+    fresh = _shard(0, final=False, age_s=0.0,
+                   heartbeats={"miner_heartbeat": {"value": 9,
+                                                   "age_s": 0.2}})
+    code, health = mesh_health(tmp_path, stall_s=5.0,
+                               heartbeat_stall_s=30.0,
+                               shards=[fresh, wedged])
+    assert code == 503
+    assert health["stale_ranks"] == [1]
+    assert health["ranks"]["1"]["stale_reason"] == "no-progress"
+    assert health["ranks"]["0"]["status"] == "ok"
+    events = telemetry.recent_events(event="mesh_rank_stale")
+    assert events[0]["reason"] == "no-progress"
+
+
+def test_mesh_health_never_heartbeat_rank_goes_stale(tmp_path):
+    """A rank that has run past the progress budget without EVER
+    heartbeating (wedged device init) is a no-progress straggler."""
+    never = dict(_shard(0, final=False, age_s=0.0),
+                 started_at=time.time() - 100)
+    young = dict(_shard(1, final=False, age_s=0.0),
+                 started_at=time.time() - 1)
+    code, health = mesh_health(tmp_path, stall_s=5.0,
+                               heartbeat_stall_s=30.0,
+                               shards=[never, young])
+    assert code == 503
+    assert health["stale_ranks"] == [0]
+    assert health["ranks"]["0"]["stale_reason"] == "no-progress"
+    assert health["ranks"]["1"]["status"] == "ok"
+
+
+def test_shard_rebind_moves_to_real_rank(tmp_path):
+    """Auto-detected distributed launches arm the writer as rank 0 on
+    every host; rebind (called from parallel/distributed.py after init)
+    must move the shard to the real process index."""
+    from mpi_blockchain_tpu.meshwatch import shard as shard_mod
+
+    shard_mod.install(tmp_path, rank=0, world_size=1, interval_s=5)
+    shard_mod.rebind_installed(3, 8)
+    assert telemetry.mesh_rank() == 3
+    shard = json.loads(shard_path(tmp_path, 3).read_text())
+    assert shard["rank"] == 3 and shard["world_size"] == 8
+    shard_mod.uninstall(status=0)
+    final = json.loads(shard_path(tmp_path, 3).read_text())
+    assert final["final"] is True and final["rank"] == 3
+
+
+def test_mesh_health_missing_rank_unhealthy(tmp_path):
+    code, health = mesh_health(
+        tmp_path, stall_s=5.0,
+        shards=[_shard(0, final=False, world=3),
+                _shard(2, final=False, world=3)])
+    assert code == 503
+    assert health["missing_ranks"] == [1]
+    assert health["ranks"]["1"]["status"] == "missing"
+
+
+def test_mesh_health_empty_directory(tmp_path):
+    code, health = mesh_health(tmp_path / "empty")
+    assert code == 503 and health["status"] == "no-shards"
+
+
+def test_render_mesh_prometheus_sum_and_rank_labels():
+    shards = [
+        _shard(0, counters={"hashes_tried_total": ({"backend": "cpu"}, 10)},
+               gauges={"chain_height": ({}, 4)}, final=False),
+        _shard(1, counters={"hashes_tried_total": ({"backend": "cpu"}, 32)},
+               gauges={"chain_height": ({}, 6)}, final=False),
+    ]
+    view = merge_shards(shards)
+    _, health = mesh_health("x", stall_s=5.0, shards=shards)
+    text = render_mesh_prometheus(view, health)
+    assert 'hashes_tried_total{backend="cpu"} 42' in text   # summed
+    assert 'chain_height{rank="0"} 4' in text               # per-rank
+    assert 'chain_height{rank="1"} 6' in text
+    assert "mesh_live_ranks 2" in text
+    assert 'mesh_rank_up{rank="0"} 1' in text
+
+
+def test_render_mesh_prometheus_no_duplicate_rank_label():
+    """A metric registered through the rank_* helpers already carries a
+    rank label; the renderer must not append the shard's rank again
+    (duplicate label names are invalid exposition text)."""
+    shards = [_shard(1, final=False, gauges={
+        "mesh_rank_local_devices": ({"rank": "1"}, 4)})]
+    text = render_mesh_prometheus(merge_shards(shards))
+    assert 'mesh_rank_local_devices{rank="1"} 4' in text
+    assert text.count('rank="1"') == 1
+
+
+# ---- pipeline profiler -------------------------------------------------
+
+
+def test_pipeline_interval_math_hand_computed():
+    """Fixture: two dispatches, device windows [0,4] and [6,8]; host
+    segments [3,5] and [5,6]. wall=[0,8]=8; device_busy=6 -> bubble
+    = 1 - 6/8 = 0.25; host_busy=[3,6]=3; overlap=[3,4]=1 -> 1/3."""
+    prof = PipelineProfiler()
+    a = prof.dispatch(kind="t")
+    a.add_segment("device", 0.0, 4.0)
+    a.add_segment("append", 3.0, 5.0)
+    b = prof.dispatch(kind="t")
+    b.add_segment("validate", 5.0, 6.0)
+    b.add_segment("device", 6.0, 8.0)
+    rep = pipeline_report(prof.records())
+    r = rep["ranks"]["0"]
+    assert r["wall_s"] == 8.0
+    assert r["device_busy_s"] == 6.0
+    assert r["bubble_fraction"] == 0.25
+    assert r["host_busy_s"] == 3.0
+    assert r["overlap_s"] == 1.0
+    assert r["host_overlapped_fraction"] == round(1 / 3, 4)
+    # Per-dispatch: a's device window [0,4] overlaps host [3,4] -> 1/4.
+    d0 = r["dispatches"][0]
+    assert d0["device_s"] == 4.0 and d0["overlap_s"] == 1.0
+    assert d0["overlap_fraction"] == 0.25
+    assert rep["bubble_fraction"] == 0.25       # single-rank mean
+
+
+def test_pipeline_overlapping_device_windows_union():
+    """Pipelined dispatches in flight together must not double-count."""
+    prof = PipelineProfiler()
+    a = prof.dispatch()
+    a.add_segment("device", 0.0, 3.0)
+    b = prof.dispatch()
+    b.add_segment("device", 2.0, 5.0)
+    r = pipeline_report(prof.records())["ranks"]["0"]
+    assert r["device_busy_s"] == 5.0            # union, not 6
+    assert r["bubble_fraction"] == 0.0
+
+
+def test_pipeline_multi_rank_report_and_trace():
+    # Both ranks' dispatch ids start at 0 (per-process profilers) — the
+    # async ids must still be globally unique (they pair by (cat, id)
+    # across processes, not per pid).
+    recs = [
+        {"dispatch": 0, "rank": 0, "meta": {},
+         "segments": [{"stage": "device", "t0": 0.0, "t1": 2.0}]},
+        {"dispatch": 0, "rank": 1, "meta": {},
+         "segments": [{"stage": "device", "t0": 0.0, "t1": 1.0},
+                      {"stage": "append", "t0": 1.0, "t1": 2.0}]},
+    ]
+    rep = pipeline_report(recs)
+    assert set(rep["ranks"]) == {"0", "1"}
+    assert rep["ranks"]["0"]["bubble_fraction"] == 0.0
+    assert rep["ranks"]["1"]["bubble_fraction"] == 0.5
+    assert rep["bubble_fraction"] == 0.25       # mean over ranks
+    trace = to_chrome_trace(recs)
+    # Device windows are async slices (b/e), host stages complete (X).
+    pids = {e["pid"] for e in trace["traceEvents"]
+            if e["ph"] in ("X", "b")}
+    assert pids == {0, 1}
+    ids = [e["id"] for e in trace["traceEvents"] if e["ph"] == "b"]
+    assert len(ids) == len(set(ids))    # rank-unique despite same d-id
+    names = {(e["pid"], e["args"]["name"])
+             for e in trace["traceEvents"]
+             if e["ph"] == "M" and e["name"] == "thread_name"}
+    # One thread row per stage per rank.
+    for stage in pipeline.STAGES:
+        assert (0, stage) in names and (1, stage) in names
+
+
+def test_trace_overlapping_device_windows_are_async_slices():
+    """Pipelined dispatches overlap PARTIALLY on the device track; the
+    trace format only lets sync (X) slices nest, so device windows must
+    export as async b/e pairs or the viewer clamps exactly the overlap
+    this export exists to show."""
+    recs = [
+        {"dispatch": 0, "rank": 0, "meta": {},
+         "segments": [{"stage": "device", "t0": 0.0, "t1": 3.0}]},
+        {"dispatch": 1, "rank": 0, "meta": {},
+         "segments": [{"stage": "device", "t0": 2.0, "t1": 5.0},
+                      {"stage": "append", "t0": 2.5, "t1": 2.8}]},
+    ]
+    ev = to_chrome_trace(recs)["traceEvents"]
+    assert not [e for e in ev if e["ph"] == "X"
+                and e["name"] == "device"]
+    begins = [e for e in ev if e["ph"] == "b"]
+    ends = [e for e in ev if e["ph"] == "e"]
+    assert len(begins) == len(ends) == 2
+    assert {e["id"] for e in begins} == {"r0d0", "r0d1"}
+    for b in begins:        # each pair shares id; end is after begin
+        e = next(x for x in ends if x["id"] == b["id"])
+        assert e["ts"] > b["ts"]
+    assert [e["name"] for e in ev if e["ph"] == "X"] == ["append"]
+
+
+def test_pipeline_segment_ctx_and_ring_bound():
+    prof = PipelineProfiler(capacity=4)
+    for _ in range(9):
+        rec = prof.dispatch()
+        with rec.segment("append"):
+            pass
+    assert len(prof.records()) == 4
+    assert prof.records()[-1]["dispatch"] == 8
+
+
+def test_pipeline_segment_on_last():
+    prof = PipelineProfiler()
+    prof.dispatch(kind="sweep")
+    with prof.segment_on_last("checkpoint"):
+        pass
+    recs = prof.records()
+    assert len(recs) == 1
+    assert recs[0]["segments"][0]["stage"] == "checkpoint"
+
+
+def test_pipeline_empty_report():
+    rep = pipeline_report([])
+    assert rep["dispatch_count"] == 0 and rep["bubble_fraction"] is None
+
+
+def test_miner_loop_records_pipeline_segments():
+    """The per-block miner emits enqueue/device/validate/append segments
+    per sweep dispatch, and the report prices a real run."""
+    from mpi_blockchain_tpu.config import MinerConfig
+    from mpi_blockchain_tpu.models.miner import Miner
+
+    miner = Miner(MinerConfig(difficulty_bits=8, n_blocks=3,
+                              backend="cpu"), log_fn=lambda d: None)
+    miner.mine_chain()
+    recs = profiler().records()
+    assert len(recs) == 3
+    stages = [s["stage"] for s in recs[0]["segments"]]
+    assert stages[:2] == ["enqueue", "device"]
+    assert "append" in stages and "validate" in stages
+    rep = pipeline_report()
+    r = rep["ranks"]["0"]
+    assert r["dispatch_count"] == 3
+    assert 0.0 <= r["bubble_fraction"] <= 1.0
+    assert r["stage_totals_s"]["device"] > 0
+    # attribute_pipeline is the same report through the perfwatch seam.
+    from mpi_blockchain_tpu.perfwatch.attribution import attribute_pipeline
+    assert attribute_pipeline()["dispatch_count"] == 3
+
+
+# ---- CLI + server ------------------------------------------------------
+
+
+def _write_live_shards(tmp_path, n=2):
+    telemetry.counter("hashes_tried_total", backend="cpu").inc(11)
+    telemetry.heartbeat("miner_heartbeat").set(3)
+    for rank in range(n):
+        ShardWriter(tmp_path, rank=rank, world_size=n).write(final=True)
+
+
+def test_cli_merge_json_and_prometheus(tmp_path, capsys):
+    from mpi_blockchain_tpu.meshwatch.__main__ import main
+
+    _write_live_shards(tmp_path)
+    assert main(["merge", "--dir", str(tmp_path)]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["health"]["healthy"] is True
+    key = "hashes_tried_total{backend=cpu}"
+    assert out["view"]["counters"][key]["total"] == 22
+    assert main(["merge", "--dir", str(tmp_path), "--prometheus"]) == 0
+    assert ('hashes_tried_total{backend="cpu"} 22'
+            in capsys.readouterr().out)
+
+
+def test_cli_merge_check_exits_nonzero_on_stale(tmp_path, capsys):
+    from mpi_blockchain_tpu.meshwatch.__main__ import main
+
+    shard_path(tmp_path, 0).parent.mkdir(parents=True, exist_ok=True)
+    shard_path(tmp_path, 0).write_text(
+        json.dumps(_shard(0, final=False, age_s=100)))
+    assert main(["merge", "--dir", str(tmp_path), "--check"]) == 1
+    out = json.loads(capsys.readouterr().out)
+    assert out["health"]["stale_ranks"] == [0]
+
+
+def test_cli_report_with_trace(tmp_path, capsys):
+    from mpi_blockchain_tpu.meshwatch.__main__ import main
+
+    rec = profiler().dispatch(kind="sweep", height=1)
+    rec.add_segment("device", 1.0, 2.0)
+    rec.add_segment("append", 2.0, 2.5)
+    ShardWriter(tmp_path, rank=0).write()
+    trace_out = tmp_path / "trace.json"
+    assert main(["report", "--dir", str(tmp_path),
+                 "--trace", str(trace_out)]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["pipeline"]["dispatch_count"] == 1
+    d = out["pipeline"]["ranks"]["0"]["dispatches"][0]
+    assert d["segments_s"] == {"device": 1.0, "append": 0.5}
+    trace = json.loads(trace_out.read_text())
+    assert out["trace"]["events"] == len(trace["traceEvents"])
+    assert {e["name"] for e in trace["traceEvents"]
+            if e["ph"] == "X"} == {"append"}
+    assert {e["name"] for e in trace["traceEvents"]
+            if e["ph"] == "b"} == {"device"}
+
+
+def test_cli_watch_once(tmp_path, capsys):
+    from mpi_blockchain_tpu.meshwatch.__main__ import main
+
+    _write_live_shards(tmp_path)
+    assert main(["watch", "--dir", str(tmp_path), "--once"]) == 0
+    assert json.loads(capsys.readouterr().out)["healthy"] is True
+    assert main(["watch", "--dir", str(tmp_path / "void"),
+                 "--once"]) == 1
+
+
+def test_mesh_server_endpoints(tmp_path):
+    import urllib.request
+
+    from mpi_blockchain_tpu.meshwatch.server import MeshServer
+
+    _write_live_shards(tmp_path)
+    srv = MeshServer(tmp_path, port=0)
+    try:
+        srv.start()
+        with urllib.request.urlopen(srv.url("/healthz"), timeout=10) as r:
+            assert r.status == 200
+            assert json.loads(r.read())["healthy"] is True
+        with urllib.request.urlopen(srv.url("/metrics"), timeout=10) as r:
+            body = r.read().decode()
+        assert 'hashes_tried_total{backend="cpu"} 22' in body
+        assert 'miner_heartbeat{rank="1"} 3' in body
+        with urllib.request.urlopen(srv.url("/ranks"), timeout=10) as r:
+            ranks = json.loads(r.read())
+        assert ranks["0"]["status"] == "finished"
+        try:
+            urllib.request.urlopen(srv.url("/nope"), timeout=10)
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+        else:
+            raise AssertionError("404 expected")
+    finally:
+        srv.close()
+
+
+# ---- multi-rank acceptance ---------------------------------------------
+
+
+def _spawn_rank(rank, world, obs_dir, difficulty, blocks, tmp_path,
+                extra_env=None, extra_argv=None):
+    env = {**os.environ,
+           "JAX_PLATFORMS": "cpu",
+           "PYTHONPATH": str(REPO),
+           "HOME": str(tmp_path),
+           "MPIBT_MESH_RANK": str(rank),
+           "MPIBT_MESH_WORLD": str(world),
+           "MPIBT_MESH_OBS_INTERVAL": "0.1",
+           **(extra_env or {})}
+    argv = [sys.executable, "-m", "mpi_blockchain_tpu", "mine",
+            "--backend", "cpu", "--difficulty", str(difficulty),
+            "--blocks", str(blocks)] + (extra_argv or [])
+    return subprocess.Popen(argv, env=env, cwd=str(REPO),
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True)
+
+
+def _wait_for_victim_heartbeat(obs, victim, timeout=60):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        shards = {s["rank"]: s for s in read_shards(obs)}
+        beats = shards.get(victim, {}).get("heartbeats", {})
+        if any("miner_heartbeat" in k for k in beats):
+            return
+        time.sleep(0.05)
+    raise AssertionError("victim rank never heartbeat")
+
+
+def _assert_killed_rank_stale(obs, world, victim):
+    shards = read_shards(obs)
+    view = merge_shards(shards)
+    code, health = mesh_health(obs, stall_s=0.5, shards=shards)
+    hashed = [v for v in view["counters"].values()
+              if v["name"] == "hashes_tried_total"]
+    assert hashed, "no hashes_tried_total in the merged view"
+    for c in hashed:
+        assert c["total"] == sum(c["by_rank"].values())
+    survivor_ranks = {str(r) for r in range(world)} - {str(victim)}
+    assert survivor_ranks <= {r for c in hashed for r in c["by_rank"]}
+    # Every rank's heartbeat individually visible in the merged view.
+    assert survivor_ranks | {str(victim)} <= {
+        r for r, b in view["heartbeats"].items()
+        if any("miner_heartbeat" in k for k in b)}
+    assert code == 503
+    assert health["stale_ranks"] == [victim]
+    for r in survivor_ranks:
+        assert health["ranks"][r]["status"] == "finished"
+    return view, health
+
+
+def _run_world_with_kill(tmp_path, world, victim):
+    obs = tmp_path / "mesh"
+    survivors = [_spawn_rank(r, world, obs, difficulty=10, blocks=15,
+                             tmp_path=tmp_path,
+                             extra_argv=["--mesh-obs", str(obs)])
+                 for r in range(world) if r != victim]
+    victim_proc = _spawn_rank(victim, world, obs, difficulty=20,
+                              blocks=4000, tmp_path=tmp_path,
+                              extra_env={"MPIBT_MESH_OBS": str(obs)})
+    try:
+        _wait_for_victim_heartbeat(obs, victim)
+        victim_proc.send_signal(signal.SIGKILL)
+        victim_proc.wait(timeout=30)
+        for p in survivors:
+            out, err = p.communicate(timeout=120)
+            assert p.returncode == 0, f"survivor failed: {err[-800:]}"
+    finally:
+        for p in survivors + [victim_proc]:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    time.sleep(0.6)    # age the victim's last shard past the budget
+    return _assert_killed_rank_stale(obs, world, victim)
+
+
+def test_mesh_obs_4rank_world_kill_one_acceptance(tmp_path):
+    """4 rank processes mining with --mesh-obs (one armed via the
+    MPIBT_MESH_OBS env, proving that path too); rank 2 is SIGKILL'd
+    mid-run and must be the ONE stale rank in the merged health."""
+    view, health = _run_world_with_kill(tmp_path, world=4, victim=2)
+    assert health["live_ranks"] == 0    # survivors finished, victim dead
+    # The shards carried real pipeline records: report + trace render.
+    records = [r for s in read_shards(tmp_path / "mesh")
+               for r in s.get("pipeline", [])]
+    rep = pipeline_report(records)
+    assert rep["dispatch_count"] > 0
+    assert rep["bubble_fraction"] is not None
+    assert len(to_chrome_trace(records)["traceEvents"]) > 0
+
+
+def test_mesh_obs_failed_rank_exit_status_in_merged_view(tmp_path):
+    """A rank that exits rc != 0 (ConfigError here) writes a final shard
+    carrying that status and reads `failed` — not `finished` — in the
+    merged health."""
+    obs = tmp_path / "mesh"
+    p = _spawn_rank(0, 1, obs, difficulty=8, blocks=2, tmp_path=tmp_path,
+                    extra_argv=["--mesh-obs", str(obs),
+                                "--checkpoint-every", "5"])   # no --checkpoint
+    out, err = p.communicate(timeout=120)
+    assert p.returncode == 2, err[-500:]
+    shards = read_shards(obs)
+    assert shards[0]["final"] is True and shards[0]["exit_status"] == 2
+    code, health = mesh_health(obs, stall_s=1e9, shards=shards)
+    assert code == 503
+    assert health["failed_ranks"] == [0]
+    assert health["ranks"]["0"]["status"] == "failed"
+
+
+@pytest.mark.slow
+def test_mesh_obs_8rank_world_kill_one_acceptance(tmp_path):
+    """The literal ISSUE acceptance shape: an 8-rank virtual-cpu run."""
+    view, health = _run_world_with_kill(tmp_path, world=8, victim=5)
+    assert health["world_size"] == 8
+
+
+def test_mesh_obs_real_multiprocess_world(tmp_path):
+    """--mesh-obs through a REAL jax.distributed 2-process world (the
+    coordinator path): each rank's shard carries its process index and
+    the merged counters sum across ranks."""
+    wrapper = ("import jax\n"
+               "jax.config.update('jax_platforms', 'cpu')\n"
+               "from mpi_blockchain_tpu.cli import main\n"
+               "import sys\n"
+               "sys.exit(main({argv!r}))\n")
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    obs = tmp_path / "mesh"
+    base = ["mine", "--difficulty", "8", "--blocks", "3",
+            "--backend", "tpu", "--kernel", "jnp", "--batch-pow2", "10",
+            "--coordinator", f"127.0.0.1:{port}",
+            "--num-processes", "2", "--mesh-obs", str(obs)]
+    env = {"PATH": "/usr/bin:/bin", "PYTHONPATH": str(REPO),
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+           "HOME": str(tmp_path)}
+    procs = [subprocess.Popen(
+        [sys.executable, "-c",
+         wrapper.format(argv=base + ["--process-id", str(i)])],
+        env=env, cwd=str(REPO), stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True) for i in range(2)]
+    outs = [p.communicate(timeout=240) for p in procs]
+    if any("Multiprocess computations aren't implemented" in err
+           for _, err in outs):
+        pytest.skip("jaxlib CPU backend lacks multiprocess computations")
+    for p, (stdout, stderr) in zip(procs, outs):
+        assert p.returncode == 0, (
+            f"worker failed rc={p.returncode}\nstderr:{stderr[-2000:]}")
+    shards = read_shards(obs)
+    assert [s["rank"] for s in shards] == [0, 1]
+    assert all(s["world_size"] == 2 and s["final"] for s in shards)
+    # mesh topology gauge stamped per-rank through the rank helper.
+    view = merge_shards(shards)
+    gkeys = [k for k in view["gauges"] if "mesh_rank_local_devices" in k]
+    assert gkeys, sorted(view["gauges"])
+    code, health = mesh_health(obs, stall_s=1e9, shards=shards)
+    assert code == 200
+    assert sorted(int(r) for r, v in health["ranks"].items()
+                  if v["status"] == "finished") == [0, 1]
+    hashed = [v for v in view["counters"].values()
+              if v["name"] == "hashes_tried_total"]
+    assert hashed and all(
+        c["total"] == sum(c["by_rank"].values()) for c in hashed)

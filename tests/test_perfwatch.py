@@ -176,6 +176,36 @@ def test_events_tail_redacts_and_bounds(server):
         assert len(r["blob"]) < 300
 
 
+def test_events_since_cursor(server):
+    """?since=SEQ returns only strictly-newer records, each stamped with
+    its seq, so a poller resumes without re-reading and deduping."""
+    for i in range(6):
+        telemetry.emit_event({"event": "pw_cursor", "n": i})
+    status, body = _get(server.url("/events?n=100"))
+    assert status == 200
+    records = [json.loads(line) for line in body.splitlines()
+               if json.loads(line).get("event") == "pw_cursor"]
+    assert [r["n"] for r in records] == list(range(6))
+    assert all("seq" in r for r in records)
+    cursor = records[2]["seq"]
+    status, body = _get(server.url(f"/events?since={cursor}"))
+    newer = [json.loads(line) for line in body.splitlines()
+             if json.loads(line).get("event") == "pw_cursor"]
+    assert [r["n"] for r in newer] == [3, 4, 5]
+    assert all(r["seq"] > cursor for r in newer)
+    # A cursor at the tip yields an empty reply, not a re-send.
+    tip = newer[-1]["seq"]
+    status, body = _get(server.url(f"/events?since={tip}"))
+    assert status == 200 and body.strip() == ""
+    # since + explicit n pages OLDEST-first: the poller advances its
+    # cursor past the page it received, so nothing is ever skipped.
+    status, body = _get(server.url(f"/events?since={cursor}&n=2"))
+    page = [json.loads(line) for line in body.splitlines()]
+    assert [r["n"] for r in page] == [3, 4]
+    status, body = _get(server.url(f"/events?since={page[-1]['seq']}&n=2"))
+    assert [json.loads(l)["n"] for l in body.splitlines()] == [5]
+
+
 def test_redact_event_unit():
     r = redact_event({"event": "e", "argv": ["a"], "cwd": "/x",
                       "height": 3})
