@@ -14,6 +14,13 @@ Peak rate derivation (public numbers only):
     => peak u32 rate = 8*128*4*1.5e9 ~= 6.1e12 ops/s.
 
 Usage: python experiments/roofline.py [measured_mhs]   (default 971.8)
+       python experiments/roofline.py --write-budget [path]
+
+``--write-budget`` re-traces the census AND recomputes chainlint's
+static ALU census, then writes OPBUDGET.json (default: repo root) — the
+committed baseline the ``opbudget`` pass ratchets against
+(docs/static_analysis.md §OPBUDGET). This is the only sanctioned way to
+MOVE the budget; the stdlib-only gate can only hold or lower it.
 """
 from __future__ import annotations
 
@@ -93,6 +100,43 @@ def roofline(measured_mhs: float = 971.8) -> dict:
             **utilization(measured_mhs * 1e6, census["alu_ops_per_nonce"])}
 
 
+def write_budget(path=None) -> dict:
+    """Writes the OPBUDGET.json baseline: the traced jaxpr census plus
+    the stdlib static census chainlint's opbudget pass recomputes."""
+    from mpi_blockchain_tpu.analysis.opbudget import (
+        CENSUS_ENTRY, KERNEL_SRC, static_alu_census)
+
+    repo = pathlib.Path(__file__).resolve().parent.parent
+    path = pathlib.Path(path) if path is not None \
+        else repo / "OPBUDGET.json"
+    static = static_alu_census(repo / KERNEL_SRC, CENSUS_ENTRY)
+    if static is None:
+        # Writing "static_alu_ops": null would report success while
+        # disarming the gate (OPB002 on the next lint run, pointing
+        # back at this very command).
+        raise RuntimeError(
+            f"census entry {CENSUS_ENTRY!r} not found in {KERNEL_SRC} — "
+            f"refusing to write an unarmed budget; update CENSUS_ENTRY "
+            f"in mpi_blockchain_tpu/analysis/opbudget.py alongside the "
+            f"rename, then rerun --write-budget")
+    budget = {
+        **count_tile_ops(),
+        "static_alu_ops": static,
+        "source": KERNEL_SRC,
+        "census_entry": CENSUS_ENTRY,
+    }
+    path.write_text(json.dumps(budget, indent=1, sort_keys=True) + "\n")
+    return budget
+
+
 if __name__ == "__main__":
-    mhs = float(sys.argv[1]) if len(sys.argv) > 1 else 971.8
-    print(json.dumps(roofline(mhs), indent=1))
+    if len(sys.argv) > 1 and sys.argv[1] == "--write-budget":
+        try:
+            out = write_budget(sys.argv[2] if len(sys.argv) > 2 else None)
+        except RuntimeError as e:
+            print(f"roofline: {e}", file=sys.stderr)
+            sys.exit(2)
+        print(json.dumps(out, indent=1, sort_keys=True))
+    else:
+        mhs = float(sys.argv[1]) if len(sys.argv) > 1 else 971.8
+        print(json.dumps(roofline(mhs), indent=1))

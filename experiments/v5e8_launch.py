@@ -171,7 +171,11 @@ def launch(n_miners: int = 8, preset_overrides: dict | None = None,
 
 def main() -> int:
     try:
-        report = launch()
+        # SPMD003 suppressed with cause: this driver is single-process —
+        # all 8 chips live in THIS process, so catching a failed launch
+        # cannot strand peer ranks in a collective (there are none); the
+        # multi-host path (parallel/distributed.py) stays unsuppressed.
+        report = launch()   # chainlint: disable=SPMD003
     except RuntimeError as e:
         print(json.dumps({"event": "v5e8_launch", "ok": False,
                           "error": str(e),

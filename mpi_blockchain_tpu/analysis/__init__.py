@@ -24,10 +24,21 @@ classic *drift* bugs at analysis time, before any run launches:
 * ``resilience_lint`` — swallow-proof fault handling in dispatch/IO
   paths: no bare ``except:`` / ``except Exception: pass`` outside the
   sanctioned resilience policy layer (RES0xx rules).
+* ``conc_lint`` — flow-aware thread-escape race detection: state mutated
+  both inside and outside a thread body without a lock (CONC0xx rules).
+* ``spmd_lint`` — collective-consistency over the mesh code paths:
+  rank-conditional collectives, non-canonical axis names, collectives
+  skippable through a swallowing ``try`` (SPMD0xx rules).
+* ``hotpath_lint`` — blocking calls reachable on the dispatch hot path
+  outside the sanctioned async seams (HOT0xx rules).
+* ``opbudget`` — the jaxpr op-budget ratchet: the kernel's static ALU
+  census must not exceed the committed ``OPBUDGET.json`` (OPB0xx rules).
 
 CLI: ``python -m mpi_blockchain_tpu.analysis`` — exits non-zero on any
-finding. Inline suppression: a ``chainlint: disable=RULE`` comment on the
-flagged line (see docs/static_analysis.md).
+finding. Findings are emitted in a deterministic (file, line, rule)
+order. Inline suppression: a ``chainlint: disable=RULE`` comment on the
+flagged line (see docs/static_analysis.md); ``--audit-suppressions``
+reports suppressions whose rule no longer fires.
 
 This module imports only the standard library (no jax, no ctypes load, no
 C++ build), so the CLI is safe to run in any environment, including ones
@@ -105,14 +116,42 @@ def default_root() -> pathlib.Path:
     return pathlib.Path(__file__).resolve().parent.parent.parent
 
 
+def rel_path(path: pathlib.Path, root: pathlib.Path) -> str:
+    """Repo-relative rendering used in findings (falls back to the
+    given path for override fixtures outside the repo). One copy: the
+    suppression/audit machinery joins findings on this string, so every
+    pass must render it identically."""
+    path = pathlib.Path(path)
+    return (str(path.relative_to(root)) if path.is_relative_to(root)
+            else str(path))
+
+
+def override_files(overrides: dict | None, key: str,
+                   default: Callable[[], Iterable[pathlib.Path]]
+                   ) -> list[pathlib.Path]:
+    """Normalizes a file-list override: absent -> ``default()``, a bare
+    str/Path (the CLI's ``--override KEY=PATH`` form) -> one-element
+    list. The one copy of the idiom every file-scoped pass needs."""
+    value = (overrides or {}).get(key)
+    if value is None:
+        value = default()
+    elif isinstance(value, (str, pathlib.Path)):
+        value = [value]
+    return [pathlib.Path(p) for p in value]
+
+
 def pass_families() -> dict[str, Callable[..., list[Finding]]]:
     """Registry of the pass families the CLI runs (import deferred so a
     syntax error in one pass does not take down the others' rule docs)."""
     from .binding_contract import run_binding_contract
+    from .conc_lint import run_conc_lint
     from .header_layout import run_header_layout
+    from .hotpath_lint import run_hotpath_lint
     from .jax_lint import run_jax_lint
+    from .opbudget import run_opbudget
     from .resilience_lint import run_resilience_lint
     from .sanitizers import run_sanitizers
+    from .spmd_lint import run_spmd_lint
     from .telemetry_lint import run_telemetry_lint
     return {
         "binding": run_binding_contract,
@@ -121,17 +160,84 @@ def pass_families() -> dict[str, Callable[..., list[Finding]]]:
         "sanitizers": run_sanitizers,
         "telemetry": run_telemetry_lint,
         "resilience": run_resilience_lint,
+        "conc": run_conc_lint,
+        "spmd": run_spmd_lint,
+        "hotpath": run_hotpath_lint,
+        "opbudget": run_opbudget,
     }
+
+
+#: Repo-relative path prefixes each family draws findings from — the
+#: ``--since REV`` changed-files mode skips families whose scope holds
+#: no changed file (a family that runs keeps ALL its findings: the
+#: cross-file contract passes can flag file A because file B changed).
+FAMILY_SCOPES: dict[str, tuple[str, ...]] = {
+    "binding": ("mpi_blockchain_tpu/core",),
+    "header": ("mpi_blockchain_tpu/core", "mpi_blockchain_tpu/ops",
+               "tests/test_header_layout.py"),
+    "jax": ("mpi_blockchain_tpu/ops", "mpi_blockchain_tpu/models",
+            "mpi_blockchain_tpu/parallel"),
+    "sanitizers": ("mpi_blockchain_tpu/core",),
+    "telemetry": ("mpi_blockchain_tpu", "experiments"),
+    "resilience": ("mpi_blockchain_tpu",),
+    "conc": ("mpi_blockchain_tpu", "experiments"),
+    "spmd": ("mpi_blockchain_tpu/parallel", "experiments"),
+    "hotpath": ("mpi_blockchain_tpu",),
+    "opbudget": ("mpi_blockchain_tpu/ops", "OPBUDGET.json",
+                 "experiments/roofline.py",
+                 "mpi_blockchain_tpu/analysis/opbudget.py"),
+}
+
+#: Rule-id prefix -> owning family (suppression audit attribution).
+RULE_FAMILIES = {"BIND": "binding", "HDR": "header", "JAX": "jax",
+                 "SAN": "sanitizers", "TEL": "telemetry",
+                 "RES": "resilience", "CONC": "conc", "SPMD": "spmd",
+                 "HOT": "hotpath", "OPB": "opbudget"}
+
+
+#: A change under the analysis engine itself (a pass module, the
+#: suppression machinery, the CLI) can alter ANY family's behavior —
+#: --since runs everything rather than guessing which rules moved.
+_ENGINE_PREFIX = "mpi_blockchain_tpu/analysis"
+
+
+def families_for_changed(changed: Iterable[str]) -> list[str]:
+    """Families whose scope intersects a changed-file set (repo-relative
+    posix paths), in registry order. Any change under the analysis
+    engine selects every family."""
+    changed = [c.replace("\\", "/") for c in changed]
+    if any(c == _ENGINE_PREFIX
+           or c.startswith(_ENGINE_PREFIX + "/") for c in changed):
+        return list(FAMILY_SCOPES)
+    selected: list[str] = []
+    for family, prefixes in FAMILY_SCOPES.items():
+        if any(c == p or c.startswith(p.rstrip("/") + "/")
+               for c in changed for p in prefixes):
+            selected.append(family)
+    return selected
 
 
 def run_all(root: pathlib.Path | None = None,
             passes: Iterable[str] | None = None,
             overrides: dict[str, pathlib.Path] | None = None,
-            notes: list[str] | None = None) -> list[Finding]:
+            notes: list[str] | None = None,
+            *,
+            apply_suppress: bool = True,
+            jobs: int = 1,
+            timings: dict[str, float] | None = None) -> list[Finding]:
     """Runs the selected pass families and returns suppression-filtered
-    findings. ``overrides`` maps checker file keys (e.g. ``capi``,
-    ``chain_hpp``) to alternate paths — the drift-fixture test seam.
-    ``notes`` collects non-finding diagnostics (e.g. skipped tools)."""
+    findings, sorted by (file, line, rule) — registration order never
+    leaks into output order. ``overrides`` maps checker file keys (e.g.
+    ``capi``, ``chain_hpp``) to alternate paths — the drift-fixture test
+    seam. ``notes`` collects non-finding diagnostics (e.g. skipped
+    tools). ``jobs`` > 1 runs the families on a thread pool (each pass
+    only reads files and builds its own ASTs, so they parallelize
+    freely); results are merged in registry order either way.
+    ``timings`` (if given) receives per-family wall milliseconds.
+    ``apply_suppress=False`` returns the RAW findings — the
+    suppression-audit path."""
+    import time
+
     root = root if root is not None else default_root()
     registry = pass_families()
     selected = list(passes) if passes is not None else list(registry)
@@ -139,8 +245,105 @@ def run_all(root: pathlib.Path | None = None,
     if unknown:
         raise ValueError(f"unknown pass families {unknown}; "
                          f"have {sorted(registry)}")
+
+    def run_one(name: str) -> list[Finding]:
+        t0 = time.perf_counter()
+        result = registry[name](root, overrides=overrides or {},
+                                notes=notes)
+        if timings is not None:
+            timings[name] = round((time.perf_counter() - t0) * 1e3, 3)
+        return result
+
     findings: list[Finding] = []
-    for name in selected:
-        findings.extend(registry[name](root, overrides=overrides or {},
-                                       notes=notes))
-    return apply_suppressions(findings, root)
+    if jobs > 1 and len(selected) > 1:
+        import concurrent.futures
+        with concurrent.futures.ThreadPoolExecutor(
+                min(jobs, len(selected))) as pool:
+            futures = {name: pool.submit(run_one, name)
+                       for name in selected}
+        for name in selected:           # registry order, not finish order
+            findings.extend(futures[name].result())
+    else:
+        for name in selected:
+            findings.extend(run_one(name))
+    findings.sort(key=lambda f: (f.file, f.line, f.rule, f.message))
+    if apply_suppress:
+        return apply_suppressions(findings, root)
+    return findings
+
+
+# ---- stale-suppression audit ----------------------------------------------
+
+_AUDIT_SUFFIXES = (".py", ".cpp", ".hpp", ".h", ".cc")
+
+
+def _audit_files(root: pathlib.Path) -> list[pathlib.Path]:
+    # tests/ is deliberately NOT scanned: its fixture literals embed
+    # `chainlint: disable=` strings that are test data, not suppressions.
+    files: list[pathlib.Path] = []
+    for base in (root / "mpi_blockchain_tpu", root / "experiments"):
+        if base.is_dir():
+            files += [p for p in base.rglob("*")
+                      if p.suffix in _AUDIT_SUFFIXES
+                      and "__pycache__" not in p.parts]
+    return sorted(files)
+
+
+def audit_suppressions(root: pathlib.Path | None = None,
+                       passes: Iterable[str] | None = None,
+                       overrides: dict | None = None,
+                       notes: list[str] | None = None,
+                       jobs: int = 1) -> list[str]:
+    """Warnings for every ``chainlint: disable=`` comment whose rule no
+    longer fires on that line (and every ``disable-file=`` whose rule
+    fires nowhere in the file). Stale suppressions rot silently — the
+    rule they silenced could return unnoticed. Only rules whose owning
+    family actually RAN are audited, so a ``--passes`` subset never
+    reports false staleness. Warning-only: warnings never fail a gate."""
+    root = root if root is not None else default_root()
+    registry = pass_families()
+    selected = list(passes) if passes is not None else list(registry)
+    raw = run_all(root=root, passes=selected, overrides=overrides,
+                  notes=notes, apply_suppress=False, jobs=jobs)
+    return audit_from_raw(root, raw, selected)
+
+
+def audit_from_raw(root: pathlib.Path, raw: Iterable[Finding],
+                   ran_families: Iterable[str]) -> list[str]:
+    """The audit computed from an existing RAW (unsuppressed) findings
+    set — the seam that lets the CLI's gating run serve the staleness
+    report without analyzing everything a second time."""
+    fired_line = {(f.file, f.line, f.rule) for f in raw}
+    fired_file = {(f.file, f.rule) for f in raw}
+    ran = set(ran_families)
+
+    def audited(rule: str) -> bool:
+        prefix = rule.rstrip("0123456789")
+        return RULE_FAMILIES.get(prefix) in ran
+
+    warnings: list[str] = []
+    for path in _audit_files(root):
+        rel = rel_path(path, root)
+        try:
+            lines = path.read_text(errors="replace").splitlines()
+        except OSError:
+            continue
+        for i, line in enumerate(lines, start=1):
+            m = _SUPPRESS_FILE_RE.search(line)
+            if m and i <= 10:
+                for rule in _suppressed_rules(m):
+                    if rule != "all" and audited(rule) and \
+                            (rel, rule) not in fired_file:
+                        warnings.append(
+                            f"{rel}:{i}: stale file-level suppression — "
+                            f"{rule} fires nowhere in this file")
+                continue
+            m = _SUPPRESS_RE.search(line)
+            if m:
+                for rule in _suppressed_rules(m):
+                    if rule != "all" and audited(rule) and \
+                            (rel, i, rule) not in fired_line:
+                        warnings.append(
+                            f"{rel}:{i}: stale suppression — {rule} no "
+                            f"longer fires on this line")
+    return warnings

@@ -20,7 +20,7 @@ import ast
 import pathlib
 import re
 
-from . import Finding
+from . import Finding, rel_path
 from .cparse import parse_extern_c_funcs, strip_comments
 
 # C parameter type -> acceptable ctypes spellings. Byte buffers cross as
@@ -57,10 +57,7 @@ SURFACE_ASYMMETRY_OK = {
 
 
 def _rel(path: pathlib.Path, root: pathlib.Path) -> str:
-    try:
-        return str(path.relative_to(root))
-    except ValueError:
-        return str(path)
+    return rel_path(path, root)
 
 
 def _ctypes_expr_name(node: ast.expr, aliases: dict[str, str]) -> str:

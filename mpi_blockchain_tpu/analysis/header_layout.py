@@ -19,7 +19,7 @@ from __future__ import annotations
 import pathlib
 import re
 
-from . import Finding
+from . import Finding, rel_path
 from .cparse import extract_function_body, parse_struct_fields
 
 CANONICAL = (("version", 4), ("prev_hash", 32), ("data_hash", 32),
@@ -37,10 +37,7 @@ def canonical_offsets() -> dict[str, tuple[int, int]]:
 
 
 def _rel(path: pathlib.Path, root: pathlib.Path) -> str:
-    try:
-        return str(path.relative_to(root))
-    except ValueError:
-        return str(path)
+    return rel_path(path, root)
 
 
 def _check_struct(findings, hpp: pathlib.Path, rel: str):

@@ -1,0 +1,191 @@
+"""HOT rules — blocking-call detection on the dispatch hot path.
+
+The roofline work made per-chip speed an algorithmic problem precisely
+because the host loop between device sweeps is tight: one dispatch per
+sweep, heartbeats and counters through the in-memory telemetry ring, and
+nothing else. The async-pipelined-dispatch refactor (ROADMAP item 4)
+lives or dies on that staying true — a single ``time.sleep``, checkpoint
+write, or socket call creeping into ``Miner.mine_block`` serializes the
+pipeline and silently re-opens the bubble the perfwatch pipeline report
+measures. This pass walks the call graph (analysis/callgraph.py) from
+the mine-loop entry points and flags blocking work reachable on the
+sweep critical path:
+
+  HOT001  a blocking call — file I/O (``open``/``os.fdopen``/pathlib
+           read/write/mkdir, ``tempfile``), ``time.sleep``, socket ops,
+           ``subprocess``/``os.system``, ``os.replace``/``rename``/
+           ``fsync`` (the checkpoint-write primitives) — reachable from
+           a hot-path entry point outside the sanctioned seams. The
+           finding message carries the call chain that reaches it.
+  HOT002  a configured hot-path entry point does not exist in the
+           analyzed file set — the lint is silently checking nothing
+           (fires when a refactor renames ``Miner.mine_chain`` without
+           updating the entry list here).
+
+Entry points: ``Miner.mine_chain``/``mine_block`` (models/miner.py) and
+``FusedMiner.mine_chain``/``_mine_span`` (models/fused.py).
+
+Sanctioned seams (pruned from traversal — blocking work INSIDE them is
+their own contract, reviewed there):
+
+* ``telemetry/`` — in-memory registry/ring/span work (JAX006 already
+  keeps it out of jit; here it is the sanctioned hot-loop sink);
+* ``meshwatch/`` — the shard flusher does its file I/O on a daemon
+  thread, off the mine loop;
+* ``perfwatch/`` — the HTTP endpoint serves on its own thread;
+* ``resilience/policy.py`` + ``resilience/injection.py`` — retry
+  backoff sleeps and injected fault sleeps are deliberate, fault-path-
+  only blocking, owned by the resilience layer;
+* ``utils/logging.py`` — delegates to the telemetry event ring.
+
+The checkpoint seam stays honest by construction: ``mine
+--checkpoint-every`` runs through the ``on_block`` callback, which a
+static call graph cannot follow — checkpoint writes only trip HOT001
+when someone wires them DIRECTLY into the mine loop, which is exactly
+the drift this rule exists to stop. Known limits in
+docs/static_analysis.md §Known limits.
+
+Scope (override key ``hotpath_files``): ``models/``, ``backend/``,
+``ops/``, ``parallel/``, ``core/*.py``, ``utils/``, ``config.py``,
+``resilience/dispatch.py``.
+"""
+from __future__ import annotations
+
+import ast
+import pathlib
+
+from . import Finding, override_files, rel_path
+from .callgraph import CallGraph, FuncInfo, call_name, dotted
+
+#: (class, method) hot-path entry points; every one must exist (HOT002).
+ENTRY_POINTS = (
+    ("Miner", "mine_chain"),
+    ("Miner", "mine_block"),
+    ("FusedMiner", "mine_chain"),
+    ("FusedMiner", "_mine_span"),
+)
+
+#: Module path prefixes (repo-relative, posix) pruned from traversal.
+SANCTIONED_SEAMS = (
+    "mpi_blockchain_tpu/telemetry",
+    "mpi_blockchain_tpu/meshwatch",
+    "mpi_blockchain_tpu/perfwatch",
+    "mpi_blockchain_tpu/resilience/policy.py",
+    "mpi_blockchain_tpu/resilience/injection.py",
+    "mpi_blockchain_tpu/utils/logging.py",
+)
+
+#: Dotted (module, func) pairs that block the calling thread.
+_BANNED_DOTTED = {
+    ("time", "sleep"),
+    ("os", "replace"), ("os", "rename"), ("os", "fsync"),
+    ("os", "fdopen"), ("os", "system"), ("os", "popen"),
+    ("socket", "socket"), ("socket", "create_connection"),
+    ("tempfile", "mkstemp"), ("tempfile", "mkdtemp"),
+    ("tempfile", "NamedTemporaryFile"), ("tempfile", "TemporaryFile"),
+    ("shutil", "copy"), ("shutil", "copyfile"), ("shutil", "move"),
+}
+
+#: Dotted prefixes that are blocking wholesale.
+_BANNED_PREFIXES = ("subprocess.", "urllib.request.")
+
+#: Bare builtin/from-imported names that block.
+_BANNED_BARE = {"open", "sleep", "mkstemp", "urlopen"}
+
+#: pathlib-style I/O method names (attribute calls on any receiver;
+#: "open" covers both ``path.open()`` and e.g. ``gzip.open``).
+_BANNED_IO_METHODS = {"open", "read_text", "write_text", "read_bytes",
+                      "write_bytes", "mkdir", "rmdir", "touch",
+                      "unlink", "hardlink_to", "symlink_to"}
+
+
+def _banned_label(node: ast.Call) -> str | None:
+    """The human label when this call is a blocking primitive."""
+    d = dotted(node.func)
+    name = call_name(node)
+    if d:
+        parts = tuple(d.split("."))
+        if len(parts) >= 2 and parts[-2:] in _BANNED_DOTTED:
+            return d
+        if any(d.startswith(p) for p in _BANNED_PREFIXES):
+            return d
+    if isinstance(node.func, ast.Name) and name in _BANNED_BARE:
+        return name
+    if isinstance(node.func, ast.Attribute) and \
+            name in _BANNED_IO_METHODS:
+        return f".{name}()"
+    return None
+
+
+def _scoped_files(root: pathlib.Path) -> list[pathlib.Path]:
+    pkg = root / "mpi_blockchain_tpu"
+    files: list[pathlib.Path] = []
+    for sub in ("models", "backend", "ops", "parallel", "utils"):
+        d = pkg / sub
+        if d.is_dir():
+            files += [p for p in d.rglob("*.py")
+                      if "__pycache__" not in p.parts]
+    core = pkg / "core"
+    if core.is_dir():
+        files += list(core.glob("*.py"))
+    for extra in (pkg / "config.py", pkg / "resilience" / "dispatch.py"):
+        if extra.is_file():
+            files.append(extra)
+    return sorted(files)
+
+
+def _is_sanctioned(info: FuncInfo) -> bool:
+    mod = info.module.replace("\\", "/")
+    return any(mod.startswith(seam) for seam in SANCTIONED_SEAMS)
+
+
+def run_hotpath_lint(root: pathlib.Path, overrides=None,
+                     notes=None) -> list[Finding]:
+    files = override_files(overrides, "hotpath_files",
+                           lambda: _scoped_files(root))
+
+    graph, errors = CallGraph.from_files(root, files)
+    findings: list[Finding] = [
+        Finding(rel, lineno, "HOT000", f"syntax error: {msg}")
+        for rel, lineno, msg in errors]
+
+    anchor = (rel_path(files[0], root) if files
+              else "mpi_blockchain_tpu")
+    roots: list[FuncInfo] = []
+    for cls, method in ENTRY_POINTS:
+        matches = [f for f in graph.functions.values()
+                   if f.cls == cls and f.name == method]
+        if matches:
+            roots.extend(matches)
+        else:
+            findings.append(Finding(
+                anchor, 1, "HOT002",
+                f"hot-path entry point {cls}.{method} not found in the "
+                f"analyzed file set — the blocking-call lint is "
+                f"checking nothing for it; update ENTRY_POINTS in "
+                f"analysis/hotpath_lint.py alongside the rename"))
+
+    chains = graph.reachable(roots, prune=_is_sanctioned)
+    seen: set[tuple[str, int]] = set()
+    for qual in sorted(chains):
+        info = graph.functions[qual]
+        for node in ast.walk(info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            label = _banned_label(node)
+            if label is None:
+                continue
+            key = (info.module, node.lineno)
+            if key in seen:
+                continue
+            seen.add(key)
+            chain = " -> ".join(chains[qual])
+            findings.append(Finding(
+                info.module, node.lineno, "HOT001",
+                f"blocking call '{label}' reachable on the dispatch hot "
+                f"path via {chain} — it serializes the sweep pipeline; "
+                f"move it behind a sanctioned async seam (telemetry "
+                f"ring, meshwatch flusher thread, the on_block "
+                f"checkpoint callback) or off the critical path "
+                f"(docs/static_analysis.md §HOTPATH)"))
+    return findings

@@ -31,7 +31,7 @@ import ast
 import pathlib
 from dataclasses import dataclass, field
 
-from . import Finding
+from . import Finding, override_files, rel_path
 
 LINT_DIRS = ("ops", "models", "parallel")
 # JAX004 scope: the kernels where every BinOp operand IS a SHA word.
@@ -318,18 +318,17 @@ def run_jax_lint(root: pathlib.Path, overrides=None,
     mesh_py = overrides.get("mesh_py", pkg / "parallel" / "mesh.py")
     canonical = _canonical_axes(mesh_py)
 
-    files: list[pathlib.Path] = list(overrides.get("jax_files", []))
-    if not files:
-        for d in LINT_DIRS:
-            files.extend(sorted((pkg / d).glob("*.py")))
+    files = override_files(
+        overrides, "jax_files",
+        lambda: [p for d in LINT_DIRS
+                 for p in sorted((pkg / d).glob("*.py"))])
 
     if not canonical and notes is not None:
         notes.append("jax: no canonical mesh axes found; JAX005 skipped")
 
     findings: list[Finding] = []
     for path in files:
-        rel = str(path.relative_to(root)) if path.is_relative_to(root) \
-            else str(path)
+        rel = rel_path(path, root)
         try:
             tree = ast.parse(path.read_text(), filename=str(path))
         except SyntaxError as e:

@@ -1,0 +1,363 @@
+"""OPB rules — the jaxpr op-budget ratchet for the sweep kernel.
+
+At 95.6% VPU utilization the only per-chip speed axis left is doing
+FEWER ops per nonce (ROADMAP item 2; AsicBoost, arxiv 1604.00575). The
+roofline experiment traces the production tile and counts jaxpr ALU
+primitives — 6055 u32 ops/nonce as of the round-4 kernel — but nothing
+stopped a refactor from silently re-inflating that count. This pass is
+the gate: a committed baseline (``OPBUDGET.json``, written by
+``python experiments/roofline.py --write-budget``) pins both the traced
+jaxpr census and a *static* ALU census that this stdlib-only pass can
+recompute on every run, and the build fails when the static census
+grows.
+
+The static census is a weighted AST op count of the kernel's tile path
+(``_tile_result`` in ``ops/sha256_pallas.py`` and everything it calls
+module-locally): arithmetic/bitwise/compare operators count 1 each,
+literal-``range`` loops multiply their body by the trip count (the 64
+SHA rounds), and per-iteration conditionals (``if r + 16 < 64``) are
+evaluated concretely per trip. It is a deterministic *proxy*, not the
+jaxpr count — any edit that adds vector ops raises it, which is all a
+ratchet needs; the traced census in the baseline stays the
+physically-meaningful number.
+
+  OPB001  the static ALU census of the kernel source exceeds the
+          committed budget — op-count work may only ratchet DOWN. If
+          the increase is justified, re-trace with
+          ``python experiments/roofline.py --write-budget`` and commit
+          the new OPBUDGET.json (its diff is the review surface); the
+          CLI's ``--rebaseline`` only accepts a LOWER census.
+  OPB002  OPBUDGET.json is missing, unparseable, or lacks the required
+          keys — the ratchet gate is not armed.
+  OPB003  the census entry function is missing from the kernel source
+          (a rename left the gate counting nothing).
+
+Override keys: ``opbudget_json`` (baseline path), ``kernel_src``
+(kernel source path) — the drift-fixture seams.
+"""
+from __future__ import annotations
+
+import ast
+import json
+import pathlib
+
+from . import Finding, rel_path
+
+BASELINE_NAME = "OPBUDGET.json"
+KERNEL_SRC = "mpi_blockchain_tpu/ops/sha256_pallas.py"
+CENSUS_ENTRY = "_tile_result"
+REQUIRED_KEYS = ("alu_ops_per_nonce", "static_alu_ops")
+
+#: Operators that occupy an ALU slot (the ratchet counts these).
+_ALU_OPS = (ast.Add, ast.Sub, ast.Mult, ast.Div, ast.FloorDiv, ast.Mod,
+            ast.Pow, ast.LShift, ast.RShift, ast.BitAnd, ast.BitOr,
+            ast.BitXor)
+_UNROLL_CAP = 4096   # literal-range trip counts beyond this count once
+
+
+class _StaticCensus:
+    """Weighted AST ALU-op counter with literal-range unrolling."""
+
+    def __init__(self, tree: ast.Module):
+        self.funcs: dict[str, ast.FunctionDef] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.funcs.setdefault(node.name, node)
+        self._memo: dict[str, int] = {}
+        self._stack: set[str] = set()
+
+    # ---- constant mini-evaluator (loop vars + literals) ------------------
+
+    def _eval(self, e: ast.expr, env: dict):
+        """int/bool value, or None when not statically known."""
+        if isinstance(e, ast.Constant) and isinstance(
+                e.value, (int, bool)):
+            return e.value
+        if isinstance(e, ast.Name):
+            return env.get(e.id)
+        if isinstance(e, ast.UnaryOp):
+            v = self._eval(e.operand, env)
+            if v is None:
+                return None
+            if isinstance(e.op, ast.USub):
+                return -v
+            if isinstance(e.op, ast.Not):
+                return not v
+            if isinstance(e.op, ast.Invert):
+                return ~v
+            return None
+        if isinstance(e, ast.BinOp):
+            lo, hi = self._eval(e.left, env), self._eval(e.right, env)
+            if lo is None or hi is None:
+                return None
+            try:
+                return {
+                    ast.Add: lambda: lo + hi, ast.Sub: lambda: lo - hi,
+                    ast.Mult: lambda: lo * hi,
+                    ast.FloorDiv: lambda: lo // hi,
+                    ast.Mod: lambda: lo % hi,
+                    ast.LShift: lambda: lo << hi,
+                    ast.RShift: lambda: lo >> hi,
+                    ast.BitAnd: lambda: lo & hi,
+                    ast.BitOr: lambda: lo | hi,
+                    ast.BitXor: lambda: lo ^ hi,
+                }[type(e.op)]()
+            except (KeyError, ZeroDivisionError, ValueError):
+                return None
+        if isinstance(e, ast.Compare) and len(e.ops) == 1:
+            lo = self._eval(e.left, env)
+            hi = self._eval(e.comparators[0], env)
+            if lo is None or hi is None:
+                return None
+            op = e.ops[0]
+            table = {ast.Lt: lambda: lo < hi, ast.LtE: lambda: lo <= hi,
+                     ast.Gt: lambda: lo > hi, ast.GtE: lambda: lo >= hi,
+                     ast.Eq: lambda: lo == hi,
+                     ast.NotEq: lambda: lo != hi}
+            fn = table.get(type(op))
+            return fn() if fn else None
+        return None
+
+    def _range_values(self, it: ast.expr, env: dict) -> list[int] | None:
+        if not (isinstance(it, ast.Call) and isinstance(it.func, ast.Name)
+                and it.func.id == "range" and not it.keywords
+                and 1 <= len(it.args) <= 3):
+            return None
+        vals = [self._eval(a, env) for a in it.args]
+        if any(v is None for v in vals):
+            return None
+        try:
+            values = list(range(*vals))
+        except (TypeError, ValueError):
+            return None
+        return values if len(values) <= _UNROLL_CAP else None
+
+    # ---- costs -----------------------------------------------------------
+
+    def func_cost(self, name: str) -> int | None:
+        if name in self._memo:
+            return self._memo[name]
+        fn = self.funcs.get(name)
+        if fn is None or name in self._stack:
+            return None
+        self._stack.add(name)
+        cost = self._block(fn.body, {})
+        self._stack.discard(name)
+        self._memo[name] = cost
+        return cost
+
+    def _block(self, stmts: list[ast.stmt], env: dict) -> int:
+        return sum(self._stmt(s, env) for s in stmts)
+
+    def _stmt(self, s: ast.stmt, env: dict) -> int:
+        if isinstance(s, ast.For):
+            values = self._range_values(s.iter, env)
+            if values is not None and isinstance(s.target, ast.Name):
+                return sum(self._block(
+                    s.body, {**env, s.target.id: v}) for v in values)
+            return self._expr(s.iter, env) + self._block(s.body, env) \
+                + self._block(s.orelse, env)
+        if isinstance(s, ast.While):
+            return self._expr(s.test, env) + self._block(s.body, env)
+        if isinstance(s, ast.If):
+            test = self._eval(s.test, env)
+            if test is True:
+                return self._block(s.body, env)
+            if test is False:
+                return self._block(s.orelse, env)
+            return self._expr(s.test, env) + max(
+                self._block(s.body, env), self._block(s.orelse, env))
+        if isinstance(s, ast.Assign):
+            return self._expr(s.value, env) + sum(
+                self._expr(t, env) for t in s.targets)
+        if isinstance(s, ast.AugAssign):
+            alu = 1 if isinstance(s.op, _ALU_OPS) else 0
+            return alu + self._expr(s.value, env) + \
+                self._expr(s.target, env)
+        if isinstance(s, ast.AnnAssign):
+            return self._expr(s.value, env) if s.value else 0
+        if isinstance(s, (ast.Return, ast.Expr)):
+            return self._expr(s.value, env) if s.value is not None else 0
+        if isinstance(s, ast.With):
+            return sum(self._expr(i.context_expr, env)
+                       for i in s.items) + self._block(s.body, env)
+        if isinstance(s, ast.Try):
+            return (self._block(s.body, env)
+                    + sum(self._block(h.body, env) for h in s.handlers)
+                    + self._block(s.orelse, env)
+                    + self._block(s.finalbody, env))
+        if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef, ast.Import, ast.ImportFrom,
+                          ast.Pass, ast.Global, ast.Nonlocal)):
+            return 0
+        # Fallback: cost of any expressions hanging off the statement.
+        return sum(self._expr(e, env) for e in ast.iter_child_nodes(s)
+                   if isinstance(e, ast.expr))
+
+    def _expr(self, e: ast.expr | None, env: dict) -> int:
+        if e is None:
+            return 0
+        if isinstance(e, ast.BinOp):
+            alu = 1 if isinstance(e.op, _ALU_OPS) else 0
+            return alu + self._expr(e.left, env) + \
+                self._expr(e.right, env)
+        if isinstance(e, ast.BoolOp):
+            return (len(e.values) - 1) + sum(
+                self._expr(v, env) for v in e.values)
+        if isinstance(e, ast.Compare):
+            return len(e.ops) + self._expr(e.left, env) + sum(
+                self._expr(c, env) for c in e.comparators)
+        if isinstance(e, ast.UnaryOp):
+            alu = 1 if isinstance(e.op, (ast.Invert, ast.USub)) else 0
+            return alu + self._expr(e.operand, env)
+        if isinstance(e, ast.IfExp):
+            test = self._eval(e.test, env)
+            if test is True:
+                return self._expr(e.body, env)
+            if test is False:
+                return self._expr(e.orelse, env)
+            return self._expr(e.test, env) + max(
+                self._expr(e.body, env), self._expr(e.orelse, env))
+        if isinstance(e, ast.Call):
+            cost = sum(self._expr(a, env) for a in e.args) + sum(
+                self._expr(k.value, env) for k in e.keywords)
+            if isinstance(e.func, ast.Name):
+                inner = self.func_cost(e.func.id)
+                if inner is not None:
+                    cost += inner
+            return cost + self._expr(e.func, env)
+        if isinstance(e, (ast.ListComp, ast.SetComp, ast.GeneratorExp)):
+            gens = e.generators
+            if len(gens) == 1 and isinstance(gens[0].target, ast.Name) \
+                    and not gens[0].ifs:
+                values = self._range_values(gens[0].iter, env)
+                if values is not None:
+                    return sum(self._expr(
+                        e.elt, {**env, gens[0].target.id: v})
+                        for v in values)
+            return self._expr(e.elt, env) + sum(
+                self._expr(g.iter, env) for g in gens)
+        # Structural nodes: sum over child expressions.
+        return sum(self._expr(c, env) for c in ast.iter_child_nodes(e)
+                   if isinstance(c, ast.expr))
+
+
+def static_alu_census(src: pathlib.Path,
+                      entry: str = CENSUS_ENTRY) -> int | None:
+    """The weighted static ALU op count of the kernel's tile path, or
+    None when the entry function is absent. Raises SyntaxError/OSError
+    for an unreadable source."""
+    tree = ast.parse(src.read_text(), filename=str(src))
+    return _StaticCensus(tree).func_cost(entry)
+
+
+def _paths(root: pathlib.Path, overrides: dict
+           ) -> tuple[pathlib.Path, pathlib.Path]:
+    baseline = pathlib.Path(overrides.get("opbudget_json",
+                                          root / BASELINE_NAME))
+    src = pathlib.Path(overrides.get("kernel_src", root / KERNEL_SRC))
+    return baseline, src
+
+
+def _rel(path: pathlib.Path, root: pathlib.Path) -> str:
+    return rel_path(path, root)
+
+
+def load_baseline(baseline: pathlib.Path) -> tuple[dict | None, str]:
+    """(budget dict, error message) — dict None iff invalid."""
+    try:
+        data = json.loads(baseline.read_text())
+    except OSError as e:
+        return None, f"cannot read {baseline.name}: {e}"
+    except ValueError as e:
+        return None, f"{baseline.name} is not valid JSON: {e}"
+    if not isinstance(data, dict):
+        return None, f"{baseline.name} must hold a JSON object"
+    for key in REQUIRED_KEYS:
+        if not isinstance(data.get(key), int) or data[key] <= 0:
+            return None, (f"{baseline.name} lacks a positive integer "
+                          f"{key!r} — regenerate it with "
+                          f"`python experiments/roofline.py "
+                          f"--write-budget`")
+    return data, ""
+
+
+def run_opbudget(root: pathlib.Path, overrides=None,
+                 notes=None) -> list[Finding]:
+    overrides = overrides or {}
+    baseline_path, src = _paths(root, overrides)
+    baseline, err = load_baseline(baseline_path)
+    if baseline is None:
+        return [Finding(_rel(baseline_path, root), 1, "OPB002",
+                        f"op-budget ratchet is not armed: {err}")]
+    src_rel = _rel(src, root)
+    try:
+        tree = ast.parse(src.read_text(), filename=str(src))
+    except SyntaxError as e:
+        return [Finding(src_rel, e.lineno or 1, "OPB000",
+                        f"syntax error: {e.msg}")]
+    except OSError as e:
+        return [Finding(src_rel, 1, "OPB003",
+                        f"kernel source unreadable: {e}")]
+    census = _StaticCensus(tree)
+    entry_fn = census.funcs.get(CENSUS_ENTRY)
+    if entry_fn is None:
+        return [Finding(src_rel, 1, "OPB003",
+                        f"census entry '{CENSUS_ENTRY}' not found in "
+                        f"{src.name} — the op-budget gate is counting "
+                        f"nothing; update CENSUS_ENTRY in "
+                        f"analysis/opbudget.py alongside the rename")]
+    current = census.func_cost(CENSUS_ENTRY) or 0
+    budget = baseline["static_alu_ops"]
+    if current > budget:
+        return [Finding(
+            src_rel, entry_fn.lineno, "OPB001",
+            f"static ALU op census grew: {current} > budget {budget} "
+            f"(committed jaxpr census: "
+            f"{baseline['alu_ops_per_nonce']} ALU ops/nonce). The op "
+            f"count only ratchets DOWN; if this increase is justified, "
+            f"re-trace with `python experiments/roofline.py "
+            f"--write-budget` and commit the OPBUDGET.json diff")]
+    if current < budget and notes is not None:
+        notes.append(f"opbudget: static census {current} is below the "
+                     f"budget {budget} — ratchet it down with "
+                     f"--rebaseline (or roofline.py --write-budget)")
+    return []
+
+
+def rebaseline(root: pathlib.Path,
+               overrides=None) -> tuple[int, int, pathlib.Path]:
+    """Writes the current static census into the baseline, refusing to
+    RAISE it (the ratchet). Returns (old, new, path). Raises ValueError
+    when the new census is higher, the source/entry is missing, or
+    there is no valid baseline to amend — a missing/corrupt
+    OPBUDGET.json must be bootstrapped by ``roofline.py
+    --write-budget`` (which traces the jaxpr census too); writing a
+    baseline without ``alu_ops_per_nonce`` here would just disarm the
+    gate with OPB002 on the next run."""
+    overrides = overrides or {}
+    baseline_path, src = _paths(root, overrides)
+    current = static_alu_census(src)
+    if current is None:
+        raise ValueError(f"census entry '{CENSUS_ENTRY}' not found in "
+                         f"{src} — nothing to baseline")
+    old_data, err = load_baseline(baseline_path)
+    if old_data is None:
+        raise ValueError(
+            f"no valid baseline to amend ({err}); bootstrap the budget "
+            f"with `python experiments/roofline.py --write-budget`")
+    old = old_data["static_alu_ops"]
+    if current > old:
+        raise ValueError(
+            f"refusing to rebaseline upward: static census {current} > "
+            f"committed budget {old}. The op budget only ratchets down; "
+            f"a justified increase must go through "
+            f"`python experiments/roofline.py --write-budget` and a "
+            f"reviewed OPBUDGET.json diff")
+    data = dict(old_data)
+    data["static_alu_ops"] = current
+    data.setdefault("source", KERNEL_SRC)
+    data.setdefault("census_entry", CENSUS_ENTRY)
+    baseline_path.write_text(json.dumps(data, indent=1, sort_keys=True)
+                             + "\n")
+    return old, current, baseline_path

@@ -43,7 +43,7 @@ from __future__ import annotations
 import ast
 import pathlib
 
-from . import Finding
+from . import Finding, override_files, rel_path
 
 #: Repo-relative dispatch/IO paths RES001 covers (files or directories).
 DISPATCH_IO_PATHS = (
@@ -96,8 +96,7 @@ def _reraises(body: list[ast.stmt]) -> bool:
 
 
 def _scan_file(root: pathlib.Path, path: pathlib.Path) -> list[Finding]:
-    rel = (str(path.relative_to(root)) if path.is_relative_to(root)
-           else str(path))
+    rel = rel_path(path, root)
     try:
         tree = ast.parse(path.read_text(), filename=str(path))
     except SyntaxError as e:
@@ -183,8 +182,7 @@ def _dotted(node: ast.expr) -> list[str]:
 
 def _scan_adversary_file(root: pathlib.Path,
                          path: pathlib.Path) -> list[Finding]:
-    rel = (str(path.relative_to(root)) if path.is_relative_to(root)
-           else str(path))
+    rel = rel_path(path, root)
     try:
         tree = ast.parse(path.read_text(), filename=str(path))
     except SyntaxError as e:
@@ -255,19 +253,13 @@ def _adversary_files(root: pathlib.Path) -> list[pathlib.Path]:
 def run_resilience_lint(root: pathlib.Path, overrides=None,
                         notes=None) -> list[Finding]:
     overrides = overrides or {}
-    files = overrides.get("resilience_files")
-    if files is None:
-        files = _scoped_files(root)
-    elif isinstance(files, (str, pathlib.Path)):
-        files = [pathlib.Path(files)]
+    files = override_files(overrides, "resilience_files",
+                           lambda: _scoped_files(root))
     findings: list[Finding] = []
     for path in files:
-        findings.extend(_scan_file(root, pathlib.Path(path)))
-    adversary = overrides.get("adversary_files")
-    if adversary is None:
-        adversary = _adversary_files(root)
-    elif isinstance(adversary, (str, pathlib.Path)):
-        adversary = [pathlib.Path(adversary)]
+        findings.extend(_scan_file(root, path))
+    adversary = override_files(overrides, "adversary_files",
+                               lambda: _adversary_files(root))
     for path in adversary:
         findings.extend(_scan_adversary_file(root, pathlib.Path(path)))
     return findings

@@ -19,16 +19,13 @@ import re
 import shutil
 import subprocess
 
-from . import Finding
+from . import Finding, rel_path
 
 FLAVORS = ("tsan", "asan", "ubsan")
 
 
 def _rel(path: pathlib.Path, root: pathlib.Path) -> str:
-    try:
-        return str(path.relative_to(root))
-    except ValueError:
-        return str(path)
+    return rel_path(path, root)
 
 
 def _check_makefile(findings, makefile: pathlib.Path, rel: str):

@@ -1,0 +1,290 @@
+"""SPMD rules — collective-consistency lint over the mesh code paths.
+
+The multi-chip scale-out path (ROADMAP item 1) is SPMD: every rank runs
+the same program, and every collective (``psum``/``pmin`` winner-select,
+``jax.distributed.initialize``, mesh builds) is a *rendezvous* — a rank
+that skips one leaves the other seven blocked in the ICI/DCN fabric
+until a watchdog kills the job. That failure mode is invisible to unit
+tests (1-process worlds never block) and miserable to debug live, which
+is why VaultxGPU-class designs (PAPERS.md, arxiv 2606.14007) structure
+consensus so accelerator ranks never diverge on collective sequences.
+These rules catch the three lexical ways a future edit makes ranks
+diverge:
+
+  SPMD001  a collective/rendezvous call lexically guarded by a
+           rank-identity conditional (``if process_index() == 0:``) —
+           rank 0 enters the collective, every other rank never does:
+           a mesh-wide hang, not an error.
+  SPMD002  a literal mesh axis name, in a collective's axis argument or
+           a mesh/shard_map axis tuple, that is not in the canonical
+           set derived from ``parallel/mesh.py`` (currently
+           ``{'miners'}``) — XLA treats unknown axis names as a new
+           mesh dimension and the program either fails to trace or
+           silently stops reducing across the real mesh.
+  SPMD003  a collective/rendezvous reachable inside a ``try`` whose
+           handler does not re-raise — a rank that catches-and-continues
+           skips the collective that the other ranks are blocked in
+           (a one-rank retry is a mesh-wide hang). Handlers that
+           re-raise (cleanup idiom) are fine.
+
+"Collective" is detected directly (``lax.psum``/``pmin``/... ,
+``jax.distributed.initialize``, the repo's ``init_distributed``) and by
+module-local propagation: a function whose body (transitively, within
+the module) calls a collective is itself a collective site at its call
+sites. Cross-module propagation and collectives reached only through
+values (a function passed to ``lax.while_loop``) are out of scope —
+the call-graph builder's known limits (docs/static_analysis.md).
+
+Scope: ``mpi_blockchain_tpu/parallel/`` and ``experiments/`` (override
+key ``spmd_files``); the canonical axis set honors the ``mesh_py``
+override shared with the JAX pass. SPMD002 overlaps JAX005 on
+``parallel/`` by design — the two passes gate different scopes and a
+drifted axis name should fail both.
+"""
+from __future__ import annotations
+
+import ast
+import pathlib
+
+from . import Finding, override_files, rel_path
+from .callgraph import call_name, dotted
+from .jax_lint import AXIS_CALLS, _canonical_axes
+
+#: Cross-rank reductions/permutations: skipping one on any rank hangs
+#: the mesh.
+COLLECTIVES = {"psum", "pmin", "pmax", "pmean", "all_gather",
+               "all_to_all", "ppermute", "pshuffle", "all_reduce"}
+
+#: World/mesh rendezvous: every rank must execute these, same order.
+#: (``jax.distributed.initialize`` — dotted or bare from-import — is
+#: handled separately in ``_is_collective_call``.)
+RENDEZVOUS = {"init_distributed", "make_mesh", "Mesh",
+              "make_miner_mesh", "make_global_miner_mesh"}
+
+#: Names in a conditional test that mark it rank-divergent.
+RANK_TESTS = {"process_index", "process_id", "rank", "node_id",
+              "local_rank", "mesh_rank", "is_coordinator"}
+
+
+def _is_collective_call(node: ast.Call) -> str | None:
+    """The op label when this call is directly a collective/rendezvous."""
+    name = call_name(node)
+    if name in COLLECTIVES:
+        return name
+    if name == "initialize":
+        # Dotted jax.distributed.initialize, or the bare from-import
+        # form (`from jax.distributed import initialize`). Other
+        # attribute calls named initialize (obj.initialize()) are not
+        # world rendezvous.
+        d = dotted(node.func)
+        if d == "initialize" or "distributed" in d.split("."):
+            return d or name
+        return None
+    if name in RENDEZVOUS:
+        return name
+    return None
+
+
+def _collective_funcs(tree: ast.Module) -> set[str]:
+    """Names of module-local functions that (transitively, module-local)
+    contain a collective — their call sites are collective sites too."""
+    local: dict[str, ast.AST] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            local.setdefault(node.name, node)
+    marked: set[str] = set()
+    changed = True
+    while changed:
+        changed = False
+        for name, fn in local.items():
+            if name in marked:
+                continue
+            for sub in ast.walk(fn):
+                if not isinstance(sub, ast.Call):
+                    continue
+                if _is_collective_call(sub) is not None or \
+                        call_name(sub) in marked:
+                    marked.add(name)
+                    changed = True
+                    break
+    return marked
+
+
+def _rank_names_in(test: ast.expr) -> set[str]:
+    found: set[str] = set()
+    for node in ast.walk(test):
+        if isinstance(node, ast.Name) and node.id in RANK_TESTS:
+            found.add(node.id)
+        elif isinstance(node, ast.Attribute) and node.attr in RANK_TESTS:
+            found.add(node.attr)
+    return found
+
+
+def _handler_reraises(handler: ast.ExceptHandler) -> bool:
+    return any(isinstance(n, ast.Raise)
+               for stmt in handler.body for n in ast.walk(stmt))
+
+
+class _ContextWalker(ast.NodeVisitor):
+    """Tracks rank-conditional and swallowing-try lexical context."""
+
+    def __init__(self, rel: str, propagated: set[str],
+                 findings: list[Finding]):
+        self.rel = rel
+        self.propagated = propagated
+        self.findings = findings
+        self._rank_if: list[tuple[int, set[str]]] = []
+        self._swallow_try: list[int] = []
+
+    # -- context ----------------------------------------------------------
+
+    def visit_If(self, node: ast.If) -> None:
+        # The test expression runs on every rank that reaches the `if`
+        # (only the ENCLOSING contexts apply to it) — visit it, or a
+        # rendezvous used AS the condition escapes both rules.
+        self.visit(node.test)
+        ranky = _rank_names_in(node.test)
+        if ranky:
+            self._rank_if.append((node.lineno, ranky))
+        for child in node.body:
+            self.visit(child)
+        if ranky:
+            self._rank_if.pop()
+        # The else/elif branch of a rank test is equally divergent.
+        if ranky:
+            self._rank_if.append((node.lineno, ranky))
+        for child in node.orelse:
+            self.visit(child)
+        if ranky:
+            self._rank_if.pop()
+
+    def visit_Try(self, node: ast.Try) -> None:
+        swallowing = any(not _handler_reraises(h) for h in node.handlers)
+        if swallowing:
+            self._swallow_try.append(node.lineno)
+        for child in node.body:
+            self.visit(child)
+        if swallowing:
+            self._swallow_try.pop()
+        # A collective inside a NON-reraising handler is the literal
+        # one-rank-retry pattern: only the rank that saw the exception
+        # re-enters the rendezvous, its peers are not there.
+        for handler in node.handlers:
+            handler_swallows = not _handler_reraises(handler)
+            if handler_swallows:
+                self._swallow_try.append(node.lineno)
+            for child in handler.body:
+                self.visit(child)
+            if handler_swallows:
+                self._swallow_try.pop()
+        for part in (node.orelse, node.finalbody):
+            for child in part:
+                self.visit(child)
+
+    # -- collective sites --------------------------------------------------
+
+    def visit_Call(self, node: ast.Call) -> None:
+        op = _is_collective_call(node)
+        if op is None and call_name(node) in self.propagated:
+            op = f"{call_name(node)} (contains a collective)"
+        if op is not None:
+            if self._rank_if:
+                line, ranky = self._rank_if[-1]
+                self.findings.append(Finding(
+                    self.rel, node.lineno, "SPMD001",
+                    f"collective/rendezvous '{op}' guarded by the "
+                    f"rank-identity conditional on line {line} "
+                    f"({sorted(ranky)}) — only some ranks enter it, the "
+                    f"rest of the mesh blocks forever; run collectives "
+                    f"unconditionally on every rank and branch on the "
+                    f"RESULT instead"))
+            if self._swallow_try:
+                self.findings.append(Finding(
+                    self.rel, node.lineno, "SPMD003",
+                    f"collective/rendezvous '{op}' inside the try on "
+                    f"line {self._swallow_try[-1]} whose handler does "
+                    f"not re-raise — a rank that swallows the failure "
+                    f"skips the collective its peers are blocked in "
+                    f"(one-rank retry = mesh-wide hang); re-raise, or "
+                    f"move the recovery outside the collective sequence"))
+        self.generic_visit(node)
+
+
+def _axis_findings(rel: str, tree: ast.Module,
+                   canonical: set[str]) -> list[Finding]:
+    """SPMD002 over every literal axis string used by a collective or a
+    mesh/shard_map axis declaration."""
+    findings: list[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = call_name(node)
+        candidates: list[ast.expr] = []
+        if name in AXIS_CALLS:
+            slot = AXIS_CALLS[name]
+            if len(node.args) > slot:
+                candidates.append(node.args[slot])
+            candidates += [k.value for k in node.keywords
+                           if k.arg in ("axis_name", "axis")]
+        elif name in ("make_mesh", "Mesh"):
+            candidates += list(node.args) + \
+                [k.value for k in node.keywords]
+        elif name == "shard_map":
+            candidates += [k.value for k in node.keywords
+                           if k.arg == "axis_names"]
+        for c in candidates:
+            elts = c.elts if isinstance(c, (ast.Tuple, ast.List)) else [c]
+            for e in elts:
+                if isinstance(e, ast.Constant) and \
+                        isinstance(e.value, str) and \
+                        e.value not in canonical:
+                    findings.append(Finding(
+                        rel, e.lineno, "SPMD002",
+                        f"mesh axis name '{e.value}' in '{name}' is not "
+                        f"in the canonical set {sorted(canonical)} "
+                        f"declared by parallel/mesh.py — the collective "
+                        f"would not reduce over the real "
+                        f"('miners',) mesh"))
+    return findings
+
+
+def _scoped_files(root: pathlib.Path) -> list[pathlib.Path]:
+    files: list[pathlib.Path] = []
+    par = root / "mpi_blockchain_tpu" / "parallel"
+    if par.is_dir():
+        files += [p for p in par.rglob("*.py")
+                  if "__pycache__" not in p.parts]
+    exp = root / "experiments"
+    if exp.is_dir():
+        files += list(exp.glob("*.py"))
+    return sorted(files)
+
+
+def run_spmd_lint(root: pathlib.Path, overrides=None,
+                  notes=None) -> list[Finding]:
+    overrides = overrides or {}
+    files = override_files(overrides, "spmd_files",
+                           lambda: _scoped_files(root))
+    mesh_py = overrides.get(
+        "mesh_py", root / "mpi_blockchain_tpu" / "parallel" / "mesh.py")
+    canonical = _canonical_axes(pathlib.Path(mesh_py))
+    if not canonical and notes is not None:
+        notes.append("spmd: no canonical mesh axes found; SPMD002 skipped")
+
+    findings: list[Finding] = []
+    for path in files:
+        path = pathlib.Path(path)
+        rel = rel_path(path, root)
+        try:
+            tree = ast.parse(path.read_text(), filename=str(path))
+        except SyntaxError as e:
+            findings.append(Finding(rel, e.lineno or 1, "SPMD000",
+                                    f"syntax error: {e.msg}"))
+            continue
+        except OSError:
+            continue
+        walker = _ContextWalker(rel, _collective_funcs(tree), findings)
+        walker.visit(tree)
+        if canonical:
+            findings.extend(_axis_findings(rel, tree, canonical))
+    return findings

@@ -45,7 +45,7 @@ from __future__ import annotations
 import ast
 import pathlib
 
-from . import Finding
+from . import Finding, override_files, rel_path
 from .jax_lint import _call_name
 
 REQUIRED_FIELDS = ("lamport", "node")
@@ -137,8 +137,7 @@ def _run_naming_lint(root: pathlib.Path, files) -> list[Finding]:
     """TEL002 over every metric registration with a literal name."""
     findings: list[Finding] = []
     for path in files:
-        rel = (str(path.relative_to(root)) if path.is_relative_to(root)
-               else str(path))
+        rel = rel_path(path, root)
         try:
             tree = ast.parse(path.read_text(), filename=str(path))
         except SyntaxError as e:
@@ -170,8 +169,7 @@ def _run_rank_label_lint(root: pathlib.Path, files) -> list[Finding]:
     multi-rank code."""
     findings: list[Finding] = []
     for path in files:
-        rel = (str(path.relative_to(root)) if path.is_relative_to(root)
-               else str(path))
+        rel = rel_path(path, root)
         try:
             tree = ast.parse(path.read_text(), filename=str(path))
         except SyntaxError as e:
@@ -200,22 +198,15 @@ def _run_rank_label_lint(root: pathlib.Path, files) -> list[Finding]:
 def run_telemetry_lint(root: pathlib.Path, overrides=None,
                        notes=None) -> list[Finding]:
     overrides = overrides or {}
-    tel_files = overrides.get("telemetry_files")
-    if tel_files is None:
-        tel_files = _package_py_files(root)
-    elif isinstance(tel_files, (str, pathlib.Path)):
-        tel_files = [pathlib.Path(tel_files)]
+    tel_files = override_files(overrides, "telemetry_files",
+                               lambda: _package_py_files(root))
     findings: list[Finding] = list(_run_naming_lint(root, tel_files))
-    rank_files = overrides.get("rank_scope_files")
-    if rank_files is None:
-        rank_files = _rank_scope_files(root)
-    elif isinstance(rank_files, (str, pathlib.Path)):
-        rank_files = [pathlib.Path(rank_files)]
+    rank_files = override_files(overrides, "rank_scope_files",
+                                lambda: _rank_scope_files(root))
     findings.extend(_run_rank_label_lint(root, rank_files))
     sim_py = overrides.get(
         "sim_py", root / "mpi_blockchain_tpu" / "simulation.py")
-    rel = (str(sim_py.relative_to(root)) if sim_py.is_relative_to(root)
-           else str(sim_py))
+    rel = rel_path(sim_py, root)
     try:
         tree = ast.parse(sim_py.read_text(), filename=str(sim_py))
     except SyntaxError as e:

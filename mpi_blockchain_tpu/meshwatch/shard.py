@@ -76,6 +76,8 @@ class ShardWriter:
         self._started_at = time.time()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        # Guards _seq: payload() runs from both the flusher thread and
+        # the closing caller (chainlint CONC001 holds this discipline).
         self._lock = threading.Lock()
 
     @property

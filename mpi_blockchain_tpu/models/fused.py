@@ -181,6 +181,11 @@ class FusedMiner:
         ``on_progress(height)`` runs after each appended span — the
         fused form of the per-block miner's checkpoint seam (the span,
         not the block, is the natural crash-recovery granule here).
+
+        chainlint HOTPATH entry point (with ``_mine_span``): blocking
+        calls reachable from here outside the sanctioned seams fail
+        ``make check`` (rule HOT001; a rename must update
+        analysis/hotpath_lint.py ENTRY_POINTS or HOT002 fires).
         """
         n = n_blocks if n_blocks is not None else self.config.n_blocks
         while n > 0:

@@ -54,6 +54,11 @@ class Miner:
         fresh space via the shared extra-nonce rule (config.extend_payload)
         — the same deterministic recovery every driver uses, so CPU / TPU /
         fused chains stay identical across a rollover.
+
+        This is a chainlint HOTPATH entry point: everything reachable
+        from here must stay free of blocking calls outside the
+        sanctioned seams (rule HOT001; renaming it requires updating
+        analysis/hotpath_lint.py ENTRY_POINTS or HOT002 fires).
         """
         height = self.node.height + 1
         if data is None:

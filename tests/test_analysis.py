@@ -639,3 +639,1154 @@ def test_res002_cli_pass_family(tmp_path):
         cwd=ROOT, capture_output=True, text=True, timeout=600)
     assert proc.returncode == 1, proc.stdout + proc.stderr
     assert "RES002" in proc.stdout
+
+
+# ---- CONC: thread-escape race detection --------------------------------
+
+
+BAD_CONC_GLOBAL = textwrap.dedent("""\
+    import threading
+
+    _shared = []
+    _counts = {}
+    _lock = threading.Lock()
+
+
+    def _worker():
+        _shared.append(1)              # CONC001: no lock anywhere
+        _counts["x"] = 1               # CONC002: other site IS locked
+
+
+    def start():
+        t = threading.Thread(target=_worker, daemon=True)
+        t.start()
+        _shared.append(2)              # CONC001: host side
+        with _lock:
+            _counts["x"] = 0           # locked side
+    """)
+
+BAD_CONC_ATTR = textwrap.dedent("""\
+    import threading
+
+
+    class Flusher:
+        def __init__(self):
+            self.seq = 0               # __init__: construction, ignored
+            self._lock = threading.Lock()
+
+        def start(self):
+            threading.Thread(target=self._loop, daemon=True).start()
+
+        def _loop(self):
+            self.seq += 1              # CONC001: thread side, no lock
+
+        def close(self):
+            self.seq += 1              # CONC001: host side, no lock
+    """)
+
+OK_CONC = textwrap.dedent("""\
+    import threading
+
+
+    class Flusher:
+        def __init__(self):
+            self.seq = 0
+            self._lock = threading.Lock()
+
+        def start(self):
+            threading.Thread(target=self._loop, daemon=True).start()
+
+        def _loop(self):
+            with self._lock:
+                self.seq += 1
+
+        def close(self):
+            with self._lock:
+                self.seq += 1
+    """)
+
+
+def _conc(tmp_path, text, name="mod.py"):
+    from mpi_blockchain_tpu.analysis.conc_lint import run_conc_lint
+
+    path = tmp_path / name
+    path.write_text(text)
+    return run_conc_lint(ROOT, overrides={"conc_files": [path]})
+
+
+def test_conc_unsynchronized_global_fires(tmp_path):
+    findings = _conc(tmp_path, BAD_CONC_GLOBAL)
+    rules = sorted(f.rule for f in findings)
+    assert rules == ["CONC001", "CONC001", "CONC002"], \
+        "\n".join(f.render() for f in findings)
+    msgs = " | ".join(f.message for f in findings)
+    assert "_shared" in msgs and "_counts" in msgs
+    assert "inconsistent" in next(f.message for f in findings
+                                  if f.rule == "CONC002").lower()
+
+
+def test_conc_unsynchronized_instance_attr_fires(tmp_path):
+    findings = _conc(tmp_path, BAD_CONC_ATTR)
+    assert [f.rule for f in findings] == ["CONC001", "CONC001"]
+    assert all("Flusher.seq" in f.message for f in findings)
+    # __init__'s construction-time write is NOT one of the flagged sites.
+    assert all(f.line != 6 for f in findings)
+
+
+def test_conc_locked_both_sides_clean(tmp_path):
+    assert _conc(tmp_path, OK_CONC) == []
+
+
+def test_conc_thread_only_mutation_clean(tmp_path):
+    """State mutated only inside the thread body never fires."""
+    one_sided = BAD_CONC_GLOBAL.replace(
+        '    _shared.append(2)              # CONC001: host side\n', "")
+    findings = _conc(tmp_path, one_sided)
+    assert "CONC001" not in {f.rule for f in findings
+                             if "_shared" in f.message}
+
+
+def test_conc_inline_suppression(tmp_path):
+    suppressed = BAD_CONC_ATTR.replace(
+        "        self.seq += 1              # CONC001: thread side, no lock",
+        "        self.seq += 1  # chainlint: disable=CONC001")
+    path = tmp_path / "mod.py"
+    path.write_text(suppressed)
+    findings = run_all(root=tmp_path, passes=["conc"],
+                       overrides={"conc_files": [path]})
+    assert len([f for f in findings if f.rule == "CONC001"]) == 1
+
+
+def test_conc_live_tree_clean():
+    """The shipping threaded substrate (meshwatch flusher, perfwatch
+    server, bench rank threads) holds its own locking discipline."""
+    from mpi_blockchain_tpu.analysis.conc_lint import run_conc_lint
+
+    findings = run_conc_lint(ROOT)
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_conc_cli_pass_family(tmp_path):
+    path = tmp_path / "mod.py"
+    path.write_text(BAD_CONC_GLOBAL)
+    proc = subprocess.run(
+        [sys.executable, "-m", "mpi_blockchain_tpu.analysis",
+         "--passes", "conc", "--override", f"conc_files={path}"],
+        cwd=ROOT, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "CONC001" in proc.stdout
+
+
+# ---- SPMD: collective-consistency lint ---------------------------------
+
+
+BAD_SPMD = textwrap.dedent("""\
+    import jax
+
+
+    def broken_winner(count):
+        if jax.process_index() == 0:
+            total = jax.lax.psum(count, "miners")     # SPMD001
+        else:
+            total = 0
+        return total
+
+
+    def bad_axis(x):
+        return jax.lax.psum(x, "rows")                # SPMD002
+
+
+    def swallowed_init():
+        try:
+            jax.distributed.initialize()              # SPMD003
+        except Exception:
+            return None
+
+
+    def fine(x):
+        try:
+            y = jax.lax.psum(x, "miners")
+        except Exception:
+            raise
+        return y
+    """)
+
+MESH_PY = ROOT / "mpi_blockchain_tpu" / "parallel" / "mesh.py"
+
+
+def _spmd(tmp_path, text):
+    from mpi_blockchain_tpu.analysis.spmd_lint import run_spmd_lint
+
+    path = tmp_path / "mod.py"
+    path.write_text(text)
+    return run_spmd_lint(ROOT, overrides={"spmd_files": [path],
+                                          "mesh_py": MESH_PY})
+
+
+def test_spmd_rules_fire(tmp_path):
+    findings = _spmd(tmp_path, BAD_SPMD)
+    assert sorted(f.rule for f in findings) == \
+        ["SPMD001", "SPMD002", "SPMD003"], \
+        "\n".join(f.render() for f in findings)
+    by_rule = {f.rule: f.message for f in findings}
+    assert "psum" in by_rule["SPMD001"]
+    assert "'rows'" in by_rule["SPMD002"] and "miners" in by_rule["SPMD002"]
+    assert "initialize" in by_rule["SPMD003"]
+
+
+def test_spmd_rank_conditional_wrapper_propagates(tmp_path):
+    """A module-local function CONTAINING a collective is itself a
+    collective site at its call sites."""
+    findings = _spmd(tmp_path, textwrap.dedent("""\
+        import jax
+
+
+        def winner_select(c):
+            return jax.lax.psum(c, "miners")
+
+
+        def driver(c, rank):
+            if rank == 0:
+                return winner_select(c)               # SPMD001 via wrapper
+            return 0
+        """))
+    assert [f.rule for f in findings] == ["SPMD001"]
+    assert "winner_select" in findings[0].message
+
+
+def test_spmd_mesh_build_under_swallowing_try_fires(tmp_path):
+    findings = _spmd(tmp_path, textwrap.dedent("""\
+        from mpi_blockchain_tpu.parallel.mesh import make_miner_mesh
+
+
+        def bring_up(n):
+            try:
+                return make_miner_mesh(n)             # SPMD003
+            except Exception:
+                return None
+        """))
+    assert [f.rule for f in findings] == ["SPMD003"]
+
+
+def test_spmd_reraising_handler_clean(tmp_path):
+    findings = _spmd(tmp_path, textwrap.dedent("""\
+        import jax
+
+
+        def cleanup_then_raise(x, writer):
+            try:
+                return jax.lax.psum(x, "miners")
+            except BaseException:
+                writer.abort()
+                raise
+        """))
+    assert findings == []
+
+
+def test_spmd_inline_suppression(tmp_path):
+    suppressed = BAD_SPMD.replace(
+        '        total = jax.lax.psum(count, "miners")     # SPMD001',
+        '        total = jax.lax.psum(count, "miners")  '
+        '# chainlint: disable=SPMD001')
+    path = tmp_path / "mod.py"
+    path.write_text(suppressed)
+    findings = run_all(root=tmp_path, passes=["spmd"],
+                       overrides={"spmd_files": [path],
+                                  "mesh_py": MESH_PY})
+    assert "SPMD001" not in {f.rule for f in findings}
+    assert {"SPMD002", "SPMD003"} <= {f.rule for f in findings}
+
+
+def test_spmd_live_tree_justified_suppressions_only():
+    """parallel/ + experiments/ run collectives unconditionally; the one
+    suppression (v5e8_launch's single-process driver) is justified
+    inline and still FIRES raw — the audit's non-stale contract."""
+    from mpi_blockchain_tpu.analysis.spmd_lint import run_spmd_lint
+
+    assert run_all(root=ROOT, passes=["spmd"]) == []
+    raw = run_spmd_lint(ROOT)
+    assert {f.rule for f in raw} <= {"SPMD003"}
+    assert all("v5e8_launch" in f.file for f in raw)
+
+
+def test_spmd_cli_pass_family(tmp_path):
+    path = tmp_path / "mod.py"
+    path.write_text(BAD_SPMD)
+    proc = subprocess.run(
+        [sys.executable, "-m", "mpi_blockchain_tpu.analysis",
+         "--passes", "spmd", "--override", f"spmd_files={path}"],
+        cwd=ROOT, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "SPMD001" in proc.stdout
+
+
+# ---- HOTPATH: blocking calls on the dispatch critical path -------------
+
+
+BAD_HOTPATH = textwrap.dedent("""\
+    import time
+
+
+    class Miner:
+        def mine_block(self):
+            return self._sweep()
+
+        def mine_chain(self, n):
+            for _ in range(n):
+                self.mine_block()
+                time.sleep(0.1)                 # HOT001: direct
+
+    def _persist(data):
+        with open("/tmp/chain.bin", "wb") as f:  # HOT001: transitive
+            f.write(data)
+
+
+    class FusedMiner:
+        def mine_chain(self, n):
+            self._mine_span(n)
+
+        def _mine_span(self, n):
+            return n
+
+
+    def _sweep_impl(self):
+        return _persist(b"x")
+    """)
+
+
+def _hotpath(tmp_path, text, name="mod.py"):
+    from mpi_blockchain_tpu.analysis.hotpath_lint import run_hotpath_lint
+
+    path = tmp_path / name
+    path.write_text(text)
+    return run_hotpath_lint(ROOT, overrides={"hotpath_files": [path]})
+
+
+def test_hotpath_direct_and_transitive_blocking_fire(tmp_path):
+    # `_sweep` resolves to _sweep_impl? No — attr `_sweep` has no def of
+    # that name; rename so the transitive chain resolves.
+    text = BAD_HOTPATH.replace("self._sweep()", "_sweep_impl(self)")
+    findings = _hotpath(tmp_path, text)
+    assert [f.rule for f in findings] == ["HOT001", "HOT001"], \
+        "\n".join(f.render() for f in findings)
+    msgs = [f.message for f in findings]
+    assert any("time.sleep" in m for m in msgs)
+    assert any("'open'" in m for m in msgs)
+    # The transitive finding names its call chain.
+    assert any("->" in m and "_persist" in m for m in msgs)
+
+
+def test_hotpath_unreachable_blocking_clean(tmp_path):
+    """Blocking work OFF the hot path (not reachable from an entry
+    point) does not fire."""
+    findings = _hotpath(tmp_path, textwrap.dedent("""\
+        import time
+
+
+        class Miner:
+            def mine_block(self):
+                return 1
+
+            def mine_chain(self, n):
+                return [self.mine_block() for _ in range(n)]
+
+
+        class FusedMiner:
+            def mine_chain(self, n):
+                return self._mine_span(n)
+
+            def _mine_span(self, n):
+                return n
+
+
+        def offline_tool():
+            time.sleep(5)
+            with open("/tmp/x", "w") as f:
+                f.write("y")
+        """))
+    assert findings == []
+
+
+def test_hotpath_missing_entry_point_fires_hot002(tmp_path):
+    findings = _hotpath(tmp_path, "def helper():\n    return 1\n")
+    assert {f.rule for f in findings} == {"HOT002"}
+    assert len(findings) == 4       # all four entry points missing
+    assert any("Miner.mine_chain" in f.message for f in findings)
+
+
+def test_hotpath_inline_suppression(tmp_path):
+    text = BAD_HOTPATH.replace("self._sweep()", "_sweep_impl(self)")
+    text = text.replace(
+        "            time.sleep(0.1)                 # HOT001: direct",
+        "            time.sleep(0.1)  # chainlint: disable=HOT001")
+    path = tmp_path / "mod.py"
+    path.write_text(text)
+    findings = run_all(root=tmp_path, passes=["hotpath"],
+                       overrides={"hotpath_files": [path]})
+    assert len([f for f in findings if f.rule == "HOT001"]) == 1
+
+
+def test_hotpath_live_tree_clean():
+    """The live mine loops reach no blocking call outside the
+    sanctioned seams — the invariant the async-dispatch refactor
+    (ROADMAP item 4) must preserve."""
+    from mpi_blockchain_tpu.analysis.hotpath_lint import run_hotpath_lint
+
+    findings = run_hotpath_lint(ROOT)
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_hotpath_cli_pass_family(tmp_path):
+    path = tmp_path / "mod.py"
+    path.write_text(BAD_HOTPATH.replace("self._sweep()",
+                                        "_sweep_impl(self)"))
+    proc = subprocess.run(
+        [sys.executable, "-m", "mpi_blockchain_tpu.analysis",
+         "--passes", "hotpath", "--override", f"hotpath_files={path}"],
+        cwd=ROOT, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "HOT001" in proc.stdout
+
+
+# ---- OPBUDGET: the op-count ratchet ------------------------------------
+
+
+import json  # noqa: E402  (test-local convenience)
+
+
+def _budget_json(tmp_path, **over):
+    data = {"alu_ops_per_nonce": 6055, "static_alu_ops": 9999, **over}
+    path = tmp_path / "OPBUDGET.json"
+    path.write_text(json.dumps(data))
+    return path
+
+
+def test_opbudget_live_tree_gate_is_armed_and_green():
+    from mpi_blockchain_tpu.analysis.opbudget import run_opbudget
+
+    assert (ROOT / "OPBUDGET.json").is_file(), \
+        "the committed baseline OPBUDGET.json is the ratchet gate"
+    assert run_opbudget(ROOT) == []
+
+
+def test_opbudget_grown_census_fires_opb001(tmp_path):
+    from mpi_blockchain_tpu.analysis.opbudget import run_opbudget
+
+    low = _budget_json(tmp_path, static_alu_ops=100)
+    findings = run_opbudget(ROOT, overrides={"opbudget_json": low})
+    assert [f.rule for f in findings] == ["OPB001"]
+    assert "ratchet" in findings[0].message.lower() or \
+        "ratchets" in findings[0].message
+    assert "sha256_pallas" in findings[0].file
+
+
+def test_opbudget_inflated_kernel_fires_opb001(tmp_path):
+    """The other direction: live budget, kernel with EXTRA ops."""
+    from mpi_blockchain_tpu.analysis.opbudget import run_opbudget
+
+    src = ROOT / "mpi_blockchain_tpu" / "ops" / "sha256_pallas.py"
+    inflated = src.read_text().replace(
+        "            ch = g ^ (e & (f ^ g))",
+        "            ch = (g ^ (e & (f ^ g))) ^ (e & f) ^ (e & f)")
+    path = tmp_path / "sha256_pallas.py"
+    path.write_text(inflated)
+    findings = run_opbudget(ROOT, overrides={"kernel_src": path})
+    assert [f.rule for f in findings] == ["OPB001"]
+
+
+def test_opbudget_missing_or_malformed_baseline_fires_opb002(tmp_path):
+    from mpi_blockchain_tpu.analysis.opbudget import run_opbudget
+
+    missing = run_opbudget(ROOT, overrides={
+        "opbudget_json": tmp_path / "nope.json"})
+    assert [f.rule for f in missing] == ["OPB002"]
+    bad = tmp_path / "bad.json"
+    bad.write_text("{oops")
+    assert [f.rule for f in run_opbudget(
+        ROOT, overrides={"opbudget_json": bad})] == ["OPB002"]
+    nokey = tmp_path / "nokey.json"
+    nokey.write_text(json.dumps({"alu_ops_per_nonce": 6055}))
+    assert [f.rule for f in run_opbudget(
+        ROOT, overrides={"opbudget_json": nokey})] == ["OPB002"]
+
+
+def test_opbudget_renamed_entry_fires_opb003(tmp_path):
+    from mpi_blockchain_tpu.analysis.opbudget import run_opbudget
+
+    path = tmp_path / "kernel.py"
+    path.write_text("def renamed_tile():\n    return 1\n")
+    findings = run_opbudget(ROOT, overrides={"kernel_src": path})
+    assert [f.rule for f in findings] == ["OPB003"]
+
+
+def test_opbudget_rebaseline_refuses_upward(tmp_path):
+    from mpi_blockchain_tpu.analysis.opbudget import rebaseline
+
+    low = _budget_json(tmp_path, static_alu_ops=100)
+    with pytest.raises(ValueError, match="ratchet"):
+        rebaseline(ROOT, overrides={"opbudget_json": low})
+    assert json.loads(low.read_text())["static_alu_ops"] == 100
+
+
+def test_opbudget_rebaseline_ratchets_down(tmp_path):
+    from mpi_blockchain_tpu.analysis.opbudget import (rebaseline,
+                                                      run_opbudget)
+
+    high = _budget_json(tmp_path, static_alu_ops=10**6)
+    old, new, path = rebaseline(ROOT, overrides={"opbudget_json": high})
+    assert old == 10**6 and 0 < new < 10**6
+    data = json.loads(path.read_text())
+    assert data["static_alu_ops"] == new
+    assert data["alu_ops_per_nonce"] == 6055    # traced census preserved
+    assert run_opbudget(ROOT, overrides={"opbudget_json": path}) == []
+
+
+def test_opbudget_cli_rebaseline_refusal_exits_2(tmp_path):
+    low = _budget_json(tmp_path, static_alu_ops=100)
+    proc = subprocess.run(
+        [sys.executable, "-m", "mpi_blockchain_tpu.analysis",
+         "--rebaseline", "--override", f"opbudget_json={low}"],
+        cwd=ROOT, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+    assert "refused" in proc.stderr
+
+
+def test_opbudget_cli_pass_family(tmp_path):
+    low = _budget_json(tmp_path, static_alu_ops=100)
+    proc = subprocess.run(
+        [sys.executable, "-m", "mpi_blockchain_tpu.analysis",
+         "--passes", "opbudget", "--override", f"opbudget_json={low}"],
+        cwd=ROOT, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "OPB001" in proc.stdout
+
+
+# ---- finding-output determinism ----------------------------------------
+
+
+def test_findings_sorted_across_files(tmp_path):
+    """(file, line, rule) order, regardless of input file order."""
+    z = tmp_path / "z_mod.py"
+    a = tmp_path / "a_mod.py"
+    for p in (z, a):
+        p.write_text('from mpi_blockchain_tpu.telemetry import counter\n'
+                     'counter("requests").inc()\n')
+    findings = run_all(root=ROOT, passes=["telemetry"],
+                       overrides={"telemetry_files": [z, a],
+                                  "rank_scope_files": [],
+                                  "sim_py": SIM_PY})
+    assert [f.file for f in findings] == sorted(f.file for f in findings)
+    assert findings[0].file.endswith("a_mod.py")
+
+
+def test_findings_sorted_across_pass_registration_order(tmp_path):
+    """Pass registration order must not leak into output order: the
+    resilience pass runs before telemetry is irrelevant — file wins."""
+    b = tmp_path / "b_dispatch.py"
+    b.write_text(BAD_SWALLOWS)
+    a = tmp_path / "a_metrics.py"
+    a.write_text(BAD_METRICS)
+    findings = run_all(root=ROOT, passes=["resilience", "telemetry"],
+                       overrides={"resilience_files": [b],
+                                  "adversary_files": [],
+                                  "telemetry_files": [a],
+                                  "rank_scope_files": [],
+                                  "sim_py": SIM_PY})
+    keys = [(f.file, f.line, f.rule) for f in findings]
+    assert keys == sorted(keys)
+    assert findings[0].rule == "TEL002"      # a_metrics.py sorts first
+
+
+def test_cli_json_shape_and_timings(tmp_path):
+    proc = subprocess.run(
+        [sys.executable, "-m", "mpi_blockchain_tpu.analysis",
+         "--passes", "header,binding", "--json", "-q", "--jobs", "2"],
+        cwd=ROOT, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["findings"] == []
+    assert set(payload["pass_timings_ms"]) == {"header", "binding"}
+    assert all(t >= 0 for t in payload["pass_timings_ms"].values())
+
+
+def test_run_all_jobs_parallel_matches_serial(tmp_path):
+    bad_m = tmp_path / "bad_metrics.py"
+    bad_m.write_text(BAD_METRICS)
+    bad_d = tmp_path / "bad_dispatch.py"
+    bad_d.write_text(BAD_SWALLOWS)
+    overrides = {"telemetry_files": [bad_m], "rank_scope_files": [],
+                 "sim_py": SIM_PY, "resilience_files": [bad_d],
+                 "adversary_files": []}
+    serial = run_all(root=ROOT, passes=["telemetry", "resilience"],
+                     overrides=overrides)
+    parallel = run_all(root=ROOT, passes=["telemetry", "resilience"],
+                       overrides=overrides, jobs=4)
+    assert serial == parallel and len(serial) == 7
+
+
+# ---- the override/suppression matrix -----------------------------------
+# Every pass family must honor BOTH its --override redirection key and
+# the file-level `chainlint: disable-file=` suppression; until this
+# matrix existed only some families had both covered.
+
+
+def _capi_case(tmp_path):
+    text = (CORE_SRC / "capi.cpp").read_text().replace(
+        '}  // extern "C"',
+        'void cc_phantom(uint32_t x) { (void)x; }\n\n}  // extern "C"')
+    path = tmp_path / "capi.cpp"
+    path.write_text(text)
+    return {"capi": path}, "BIND001", path, "// "
+
+
+def _chain_hpp_case(tmp_path):
+    text = (CORE_SRC / "chain.hpp").read_text().replace(
+        "  uint32_t timestamp = 0;\n  uint32_t bits = 0;\n"
+        "  uint32_t nonce = 0;\n",
+        "  uint32_t nonce = 0;\n  uint32_t timestamp = 0;\n"
+        "  uint32_t bits = 0;\n")
+    path = tmp_path / "chain.hpp"
+    path.write_text(text)
+    return {"chain_hpp": path}, "HDR001", path, "// "
+
+
+def _jax_case(tmp_path):
+    path = tmp_path / "bad_kernel.py"
+    path.write_text(BAD_JAX)
+    return {"jax_files": [path], "mesh_py": MESH_PY}, "JAX003", path, "# "
+
+
+def _san_case(tmp_path):
+    path = tmp_path / "Makefile"
+    path.write_text("sanity_tsan:\n\techo t\n\nsanity_asan:\n\techo a\n")
+    return ({"core_makefile": path, "core_src": tmp_path / "nosrc"},
+            "SAN001", path, "# ")
+
+
+def _tel_case(tmp_path):
+    path = tmp_path / "bad_metrics.py"
+    path.write_text(BAD_METRICS)
+    return ({"telemetry_files": [path], "rank_scope_files": [],
+             "sim_py": SIM_PY}, "TEL002", path, "# ")
+
+
+def _res_case(tmp_path):
+    path = tmp_path / "bad_dispatch.py"
+    path.write_text(BAD_SWALLOWS)
+    return ({"resilience_files": [path], "adversary_files": []},
+            "RES001", path, "# ")
+
+
+def _conc_case(tmp_path):
+    path = tmp_path / "bad_threads.py"
+    path.write_text(BAD_CONC_ATTR)
+    return {"conc_files": [path]}, "CONC001", path, "# "
+
+
+def _spmd_case(tmp_path):
+    path = tmp_path / "bad_spmd.py"
+    path.write_text(BAD_SPMD)
+    return ({"spmd_files": [path], "mesh_py": MESH_PY}, "SPMD001",
+            path, "# ")
+
+
+def _hot_case(tmp_path):
+    path = tmp_path / "bad_hot.py"
+    path.write_text(BAD_HOTPATH.replace("self._sweep()",
+                                        "_sweep_impl(self)"))
+    return {"hotpath_files": [path]}, "HOT001", path, "# "
+
+
+def _opb_case(tmp_path):
+    budget = tmp_path / "OPBUDGET.json"
+    budget.write_text(json.dumps({"alu_ops_per_nonce": 6055,
+                                  "static_alu_ops": 100}))
+    src = tmp_path / "sha256_pallas.py"
+    src.write_text((ROOT / "mpi_blockchain_tpu" / "ops"
+                    / "sha256_pallas.py").read_text())
+    return ({"opbudget_json": budget, "kernel_src": src}, "OPB001",
+            src, "# ")
+
+
+MATRIX_CASES = {
+    "binding": _capi_case, "header": _chain_hpp_case, "jax": _jax_case,
+    "sanitizers": _san_case, "telemetry": _tel_case,
+    "resilience": _res_case, "conc": _conc_case, "spmd": _spmd_case,
+    "hotpath": _hot_case, "opbudget": _opb_case,
+}
+
+
+@pytest.mark.parametrize("family", sorted(MATRIX_CASES))
+def test_matrix_override_key_and_disable_file(family, tmp_path):
+    from mpi_blockchain_tpu.analysis.__main__ import OVERRIDE_KEYS
+
+    overrides, rule, finding_file, comment = MATRIX_CASES[family](tmp_path)
+    # Every override key used here is CLI-reachable.
+    assert set(overrides) <= set(OVERRIDE_KEYS)
+    findings = run_all(root=ROOT, passes=[family], overrides=overrides)
+    assert rule in {f.rule for f in findings}, \
+        f"{family}: {rule} did not fire via its override key"
+    assert any(f.file == str(finding_file) for f in findings
+               if f.rule == rule), \
+        f"{family}: {rule} not attributed to the overridden file"
+    # disable-file in the first 10 lines kills exactly that rule.
+    finding_file.write_text(
+        f"{comment}chainlint: disable-file={rule}\n"
+        + finding_file.read_text())
+    suppressed = run_all(root=ROOT, passes=[family], overrides=overrides)
+    assert rule not in {f.rule for f in suppressed}, \
+        f"{family}: disable-file did not suppress {rule}"
+
+
+# ---- --since changed-files mode ----------------------------------------
+
+
+def _git_ok():
+    try:
+        return subprocess.run(["git", "rev-parse", "HEAD"], cwd=ROOT,
+                              capture_output=True,
+                              timeout=30).returncode == 0
+    except OSError:
+        return False
+
+
+def test_families_for_changed_scoping():
+    from mpi_blockchain_tpu.analysis import (FAMILY_SCOPES,
+                                             families_for_changed,
+                                             pass_families)
+
+    assert set(FAMILY_SCOPES) == set(pass_families())
+    assert families_for_changed([]) == []
+    assert families_for_changed(["README.md"]) == []
+    got = families_for_changed(["mpi_blockchain_tpu/core/src/capi.cpp"])
+    assert {"binding", "header", "sanitizers"} <= set(got)
+    assert "spmd" not in got
+    got = families_for_changed(["experiments/v5e8_launch.py"])
+    assert {"telemetry", "conc", "spmd"} <= set(got)
+    assert "binding" not in got
+    assert "opbudget" in families_for_changed(["OPBUDGET.json"])
+
+
+@pytest.mark.skipif(not _git_ok(), reason="git unavailable")
+def test_cli_since_mode_runs_clean():
+    proc = subprocess.run(
+        [sys.executable, "-m", "mpi_blockchain_tpu.analysis",
+         "--since", "HEAD"],
+        cwd=ROOT, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "pass families" in proc.stderr
+
+
+def test_cli_since_bad_rev_exits_2():
+    proc = subprocess.run(
+        [sys.executable, "-m", "mpi_blockchain_tpu.analysis",
+         "--since", "not-a-rev-zzz"],
+        cwd=ROOT, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+
+
+# ---- --audit-suppressions ----------------------------------------------
+
+
+def _audit_root(tmp_path):
+    pkg = tmp_path / "mpi_blockchain_tpu"
+    pkg.mkdir()
+    return tmp_path, pkg
+
+
+def test_audit_reports_stale_line_suppression(tmp_path):
+    from mpi_blockchain_tpu.analysis import audit_suppressions
+
+    root, pkg = _audit_root(tmp_path)
+    (pkg / "mod.py").write_text(textwrap.dedent("""\
+        from mpi_blockchain_tpu.telemetry import counter, gauge
+
+
+        def instrument():
+            counter("requests").inc()  # chainlint: disable=TEL002
+            gauge("ok_heartbeat").set(1)  # chainlint: disable=TEL002
+            x = 1  # chainlint: disable=RES001
+            return x
+        """))
+    warnings = audit_suppressions(root=root, passes=["telemetry"],
+                                  overrides={"sim_py": SIM_PY})
+    # Line 5's suppression covers a REAL raw finding: not stale. Line
+    # 6's rule never fires there: stale. Line 7's RES001 belongs to a
+    # family that did not run: not audited.
+    assert len(warnings) == 1, warnings
+    assert "mod.py:6" in warnings[0] and "TEL002" in warnings[0]
+
+
+def test_audit_reports_stale_file_suppression(tmp_path):
+    from mpi_blockchain_tpu.analysis import audit_suppressions
+
+    root, pkg = _audit_root(tmp_path)
+    (pkg / "mod.py").write_text(
+        "# chainlint: disable-file=TEL002\n"
+        "from mpi_blockchain_tpu.telemetry import gauge\n\n\n"
+        "def instrument():\n"
+        '    gauge("ok_heartbeat").set(1)\n')
+    warnings = audit_suppressions(root=root, passes=["telemetry"],
+                                  overrides={"sim_py": SIM_PY})
+    assert len(warnings) == 1 and "fires nowhere" in warnings[0]
+
+
+def test_audit_live_tree_has_no_stale_suppressions():
+    """Every shipped suppression still covers a raw finding — the
+    in-PR-justified ones included."""
+    from mpi_blockchain_tpu.analysis import (audit_suppressions,
+                                             pass_families)
+
+    passes = [p for p in pass_families() if p != "sanitizers"]
+    warnings = audit_suppressions(root=ROOT, passes=passes)
+    assert warnings == [], "\n".join(warnings)
+
+
+def test_audit_cli_always_exits_zero(tmp_path):
+    root, pkg = _audit_root(tmp_path)
+    (pkg / "mod.py").write_text(
+        "from mpi_blockchain_tpu.telemetry import gauge\n\n\n"
+        "def f():\n"
+        '    gauge("ok_heartbeat").set(1)  # chainlint: disable=TEL002\n')
+    proc = subprocess.run(
+        [sys.executable, "-m", "mpi_blockchain_tpu.analysis",
+         "--audit-suppressions", "--passes", "telemetry",
+         "--root", str(root), "--override",
+         f"sim_py={SIM_PY}"],
+        cwd=ROOT, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "stale suppression" in proc.stdout
+
+
+# ---- second drift fixtures (each rule fires from >=2 distinct drifts) --
+
+
+def test_conc002_inconsistent_instance_lock_fires(tmp_path):
+    """Attr variant of CONC002: locked in the thread body, bare in the
+    host-side close path."""
+    findings = _conc(tmp_path, textwrap.dedent("""\
+        import threading
+
+
+        class Writer:
+            def __init__(self):
+                self.pending = []
+                self._lock = threading.Lock()
+
+            def start(self):
+                threading.Thread(target=self._flush, daemon=True).start()
+
+            def _flush(self):
+                with self._lock:
+                    self.pending.clear()
+
+            def push(self, item):
+                self.pending.append(item)      # CONC002: no lock here
+        """))
+    assert [f.rule for f in findings] == ["CONC002"]
+    assert "Writer.pending" in findings[0].message
+
+
+def test_spmd002_mesh_build_axis_fires(tmp_path):
+    """Axis drift at the mesh DECLARATION site, not a collective arg."""
+    findings = _spmd(tmp_path, textwrap.dedent("""\
+        import jax
+
+
+        def build(n):
+            return jax.make_mesh((n,), ("workers",))   # SPMD002
+        """))
+    assert [f.rule for f in findings] == ["SPMD002"]
+    assert "'workers'" in findings[0].message
+
+
+def test_hot001_checkpoint_write_in_fused_span_fires(tmp_path):
+    """The exact drift HOTPATH exists for: a checkpoint-style atomic
+    write wired directly into the fused span instead of on_progress."""
+    findings = _hotpath(tmp_path, textwrap.dedent("""\
+        import os
+
+
+        class Miner:
+            def mine_block(self):
+                return 1
+
+            def mine_chain(self, n):
+                return [self.mine_block() for _ in range(n)]
+
+
+        class FusedMiner:
+            def mine_chain(self, n):
+                return self._mine_span(n)
+
+            def _mine_span(self, n):
+                _save_checkpoint(b"chain")
+                return n
+
+
+        def _save_checkpoint(blob):
+            with open("/tmp/ck.tmp", "wb") as f:    # HOT001
+                f.write(blob)
+            os.replace("/tmp/ck.tmp", "/tmp/ck")    # HOT001
+        """))
+    assert [f.rule for f in findings] == ["HOT001", "HOT001"]
+    assert any("os.replace" in f.message for f in findings)
+    assert all("FusedMiner._mine_span" in f.message for f in findings)
+
+
+def test_hot002_partial_entry_set_fires(tmp_path):
+    """Only FusedMiner survives a refactor: exactly the Miner entries
+    are reported missing."""
+    findings = _hotpath(tmp_path, textwrap.dedent("""\
+        class FusedMiner:
+            def mine_chain(self, n):
+                return self._mine_span(n)
+
+            def _mine_span(self, n):
+                return n
+        """))
+    assert [f.rule for f in findings] == ["HOT002", "HOT002"]
+    assert all("Miner." in f.message for f in findings)
+
+
+def test_opbudget_entry_demoted_to_method_fires_opb003(tmp_path):
+    """A module-level _tile_result moved into a class is no longer the
+    module-local census entry — the gate must say so, not go green."""
+    from mpi_blockchain_tpu.analysis.opbudget import run_opbudget
+
+    path = tmp_path / "kernel.py"
+    path.write_text(textwrap.dedent("""\
+        class Kernel:
+            @staticmethod
+            def tile_result(m, t, b):
+                return m ^ t ^ b
+        """))
+    findings = run_opbudget(ROOT, overrides={"kernel_src": path})
+    assert [f.rule for f in findings] == ["OPB003"]
+
+
+# ---- review-pass regression pins ---------------------------------------
+
+
+def test_spmd003_retry_in_handler_fires(tmp_path):
+    """The literal one-rank-retry: a collective re-entered inside a
+    non-reraising except handler must fire even though the try body's
+    collective is also flagged."""
+    findings = _spmd(tmp_path, textwrap.dedent("""\
+        import jax
+
+
+        def retry_alone(x):
+            try:
+                return jax.lax.psum(x, "miners")
+            except RuntimeError:
+                return jax.lax.psum(x, "miners")   # one-rank retry
+        """))
+    assert [f.rule for f in findings] == ["SPMD003", "SPMD003"]
+    assert {f.line for f in findings} == {6, 8}
+
+
+def test_spmd_bare_from_import_initialize_detected(tmp_path):
+    """`from jax.distributed import initialize` must not dodge the
+    rules; an unrelated obj.initialize() must not trip them."""
+    findings = _spmd(tmp_path, textwrap.dedent("""\
+        from jax.distributed import initialize
+
+
+        def join(rank):
+            if rank == 0:
+                initialize()                       # SPMD001
+
+
+        def harmless(engine):
+            engine.initialize()                    # not a rendezvous
+        """))
+    assert [f.rule for f in findings] == ["SPMD001"]
+    assert findings[0].line == 6
+
+
+def test_opbudget_rebaseline_requires_valid_baseline(tmp_path):
+    """A missing/corrupt baseline must be refused, not silently
+    replaced with an unarmed one that OPB002s on the next run."""
+    from mpi_blockchain_tpu.analysis.opbudget import rebaseline
+
+    missing = tmp_path / "OPBUDGET.json"
+    with pytest.raises(ValueError, match="write-budget"):
+        rebaseline(ROOT, overrides={"opbudget_json": missing})
+    assert not missing.exists()
+    missing.write_text("{corrupt")
+    with pytest.raises(ValueError, match="write-budget"):
+        rebaseline(ROOT, overrides={"opbudget_json": missing})
+    assert missing.read_text() == "{corrupt"
+
+
+def test_audit_suppressions_jobs_parallel_matches_serial(tmp_path):
+    from mpi_blockchain_tpu.analysis import audit_suppressions
+
+    root, pkg = _audit_root(tmp_path)
+    (pkg / "mod.py").write_text(
+        "from mpi_blockchain_tpu.telemetry import gauge\n\n\n"
+        "def f():\n"
+        '    gauge("ok_heartbeat").set(1)  # chainlint: disable=TEL002\n')
+    kwargs = dict(root=root, passes=["telemetry", "resilience"],
+                  overrides={"sim_py": SIM_PY})
+    assert audit_suppressions(**kwargs) == \
+        audit_suppressions(**kwargs, jobs=4)
+
+
+@pytest.mark.skipif(not _git_ok(), reason="git unavailable")
+def test_since_mode_sees_untracked_files(tmp_path):
+    """A brand-new (untracked) file must select its pass families —
+    `git diff` alone would let it sail through lint-fast green."""
+    from mpi_blockchain_tpu.analysis.__main__ import _changed_files
+
+    scratch = tmp_path / "repo"
+    (scratch / "mpi_blockchain_tpu").mkdir(parents=True)
+    env_cmds = [
+        ["git", "init", "-q"],
+        ["git", "-c", "user.email=t@t", "-c", "user.name=t",
+         "commit", "-q", "--allow-empty", "-m", "seed"],
+    ]
+    for cmd in env_cmds:
+        subprocess.run(cmd, cwd=scratch, check=True, timeout=60,
+                       capture_output=True)
+    new = scratch / "mpi_blockchain_tpu" / "brand_new.py"
+    new.write_text("x = 1\n")
+    changed = _changed_files(scratch, "HEAD")
+    assert changed == ["mpi_blockchain_tpu/brand_new.py"]
+    from mpi_blockchain_tpu.analysis import families_for_changed
+    assert "conc" in families_for_changed(changed)
+
+
+# ---- review hardening: lock-token matching, write-budget refusal, and
+# ---- the audit riding the gating run -----------------------------------
+
+
+def test_conc_lock_match_is_tokenwise_not_substring(tmp_path):
+    """`with deadline_seconds(...)` must NOT read as a lock ('cond' is
+    an accident of 'seconds'): the race reports as plain CONC001 with
+    no phantom lock-holding site, not CONC002."""
+    findings = _conc(tmp_path, textwrap.dedent("""\
+        import threading
+
+        _ring = []
+
+
+        def deadline_seconds(n):
+            return n
+
+
+        def flusher():
+            with deadline_seconds(5):
+                _ring.append(1)
+
+
+        def start():
+            threading.Thread(target=flusher, daemon=True).start()
+            _ring.append(2)
+        """))
+    rules = sorted(f.rule for f in findings)
+    assert rules == ["CONC001", "CONC001"], findings
+
+
+def test_conc_lock_match_accepts_rlock_spelling(tmp_path):
+    findings = _conc(tmp_path, textwrap.dedent("""\
+        import threading
+
+        _ring = []
+        _rlock = threading.RLock()
+
+
+        def flusher():
+            with _rlock:
+                _ring.append(1)
+
+
+        def start():
+            threading.Thread(target=flusher, daemon=True).start()
+            with _rlock:
+                _ring.append(2)
+        """))
+    assert findings == []
+
+
+def test_roofline_write_budget_refuses_missing_entry(tmp_path, monkeypatch):
+    """--write-budget must fail loudly (and write nothing) when the
+    census entry function is gone — a null static_alu_ops baseline
+    would disarm the gate while reporting success."""
+    from mpi_blockchain_tpu.analysis import opbudget
+    monkeypatch.setattr(opbudget, "CENSUS_ENTRY", "_renamed_away")
+    sys.path.insert(0, str(ROOT / "experiments"))
+    try:
+        import roofline
+    finally:
+        sys.path.pop(0)
+    out = tmp_path / "budget.json"
+    with pytest.raises(RuntimeError, match="_renamed_away"):
+        roofline.write_budget(out)
+    assert not out.exists()
+
+
+def test_audit_suppressions_rides_the_gating_run(tmp_path):
+    """--audit-suppressions composes with the lint in ONE run: findings
+    still gate (rc 1), the stale report is appended warning-only, and
+    --json carries it under stale_suppressions."""
+    path = tmp_path / "mod.py"
+    path.write_text(BAD_CONC_GLOBAL)
+    proc = subprocess.run(
+        [sys.executable, "-m", "mpi_blockchain_tpu.analysis",
+         "--passes", "conc", "--override", f"conc_files={path}",
+         "--audit-suppressions", "--json"],
+        cwd=ROOT, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["findings"], "the gate must still see the findings"
+    assert payload["stale_suppressions"] == []
+
+
+def test_conc_closure_thread_body_fires(tmp_path):
+    """The thread-body-as-closure idiom (`def _loop(): self.seq += 1`
+    passed as Thread target inside a method) must be visible: nested
+    defs keep the enclosing class, so the closure's `self` mutations
+    key to the same instance state as the host-side ones."""
+    findings = _conc(tmp_path, textwrap.dedent("""\
+        import threading
+
+
+        class Writer:
+            def start(self):
+                def _loop():
+                    self.seq += 1
+                threading.Thread(target=_loop, daemon=True).start()
+
+            def close(self):
+                self.seq += 1
+        """))
+    rules = sorted(f.rule for f in findings)
+    assert rules == ["CONC001", "CONC001"], findings
+
+
+def test_hotpath_path_open_method_fires(tmp_path):
+    """`path.open("w")` blocks exactly like the `open(path, "w")`
+    spelling and must trip HOT001 on the hot path too."""
+    path = tmp_path / "mod.py"
+    path.write_text(textwrap.dedent("""\
+        class Miner:
+            def mine_chain(self):
+                self.mine_block()
+
+            def mine_block(self):
+                self._ckpt.open("w").write("x")
+
+
+        class FusedMiner:
+            def mine_chain(self):
+                self._mine_span()
+
+            def _mine_span(self):
+                pass
+        """))
+    from mpi_blockchain_tpu.analysis.hotpath_lint import run_hotpath_lint
+    findings = run_hotpath_lint(ROOT, overrides={"hotpath_files": [path]})
+    assert [f.rule for f in findings] == ["HOT001"], findings
+    assert ".open()" in findings[0].message
