@@ -5,10 +5,11 @@ PY ?= python3
 SHELL := /bin/bash   # tier1 uses pipefail/PIPESTATUS
 
 .PHONY: check lint lint-fast metrics-smoke forensics-smoke perf-smoke \
-        chaos-smoke adversary-smoke meshwatch-smoke tier1 core clean
+        chaos-smoke adversary-smoke meshwatch-smoke elastic-smoke \
+        tier1 core clean
 
 check: lint metrics-smoke forensics-smoke perf-smoke chaos-smoke \
-        adversary-smoke meshwatch-smoke tier1
+        adversary-smoke meshwatch-smoke elastic-smoke tier1
 
 # chainlint: binding contract, header layout, JAX purity, sanitizer
 # matrix, thread races (CONC), SPMD collectives, hot-path blocking,
@@ -121,6 +122,16 @@ meshwatch-smoke:
 	env JAX_PLATFORMS=cpu $(PY) -m mpi_blockchain_tpu.meshwatch smoke \
 	    2>/dev/null || { echo "meshwatch-smoke: failed"; exit 1; }; \
 	echo "meshwatch-smoke: ok"
+
+# Elastic smoke: the ISSUE 9 gate — a 4-rank striped elastic world with
+# one rank SIGKILL'd mid-run must evict it via meshwatch shard staleness
+# (not a timeout guess), re-stripe over the survivors, finish rc 0 with
+# an oracle-valid chain; and two same-seed mesh.rank_death runs must
+# produce byte-identical causal dumps (docs/resilience.md §Elastic mesh).
+elastic-smoke:
+	env JAX_PLATFORMS=cpu $(PY) -m mpi_blockchain_tpu.resilience \
+	    elastic-smoke 2>/dev/null || { echo "elastic-smoke: failed"; exit 1; }; \
+	echo "elastic-smoke: ok"
 
 # Perfwatch smoke: serve a faulted instrumented run, scrape /metrics +
 # /healthz live, then prove the regression sentinel flags an injected

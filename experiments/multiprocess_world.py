@@ -9,12 +9,23 @@ the determinism contract across real process boundaries at the reference
 baseline's full rank count.
 
 Usage: python experiments/multiprocess_world.py [n_processes=8] [mesh_obs_dir]
+       python experiments/multiprocess_world.py [n] [mesh_obs_dir] --elastic
 
 With a mesh_obs_dir (or env MPIBT_MESH_OBS), every rank additionally
 writes its telemetry shard there (``--mesh-obs``), and the summary line
 carries the MERGED mesh view's health + summed hash counters — the
 per-rank observability this launch shape exists to exercise
 (docs/observability.md §Mesh shards).
+
+``--elastic`` switches to the rank-death-survivable launch shape
+(docs/resilience.md §Elastic mesh): NO jax.distributed world (a jax
+world pins its size at init and cannot shrink) — each rank is an
+independent ``mine --elastic`` process sweeping its stripe of the nonce
+space, with the shared shard directory as the death oracle. Chains are
+rank-dependent (each rank takes the lowest qualifier in its OWN
+stripes), so the summary validates rank 0's chain through the full C++
+PoW+linkage loader instead of byte-comparing it to the single-rank
+oracle, and carries every rank's live/evicted membership.
 """
 from __future__ import annotations
 
@@ -41,7 +52,8 @@ sys.exit(main({argv!r}))
 """
 
 
-def main(n_processes: int = 8, mesh_obs: str | None = None) -> int:
+def main(n_processes: int = 8, mesh_obs: str | None = None,
+         elastic: bool = False) -> int:
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         port = s.getsockname()[1]
@@ -49,10 +61,19 @@ def main(n_processes: int = 8, mesh_obs: str | None = None) -> int:
     out_file = tmp + "/chain.bin"
     if mesh_obs is None:
         mesh_obs = os.environ.get("MPIBT_MESH_OBS") or None
-    base = ["mine", "--difficulty", str(DIFF), "--blocks", str(BLOCKS),
-            "--backend", "tpu", "--kernel", "jnp", "--batch-pow2", "10",
-            "--coordinator", f"127.0.0.1:{port}",
-            "--num-processes", str(n_processes)]
+    if elastic:
+        # The elastic shape needs the shard oracle — default it into the
+        # scratch dir rather than silently running detection-blind.
+        mesh_obs = mesh_obs or tmp + "/mesh"
+        base = ["mine", "--difficulty", str(DIFF), "--blocks",
+                str(BLOCKS), "--backend", "cpu", "--elastic",
+                "--num-processes", str(n_processes)]
+    else:
+        base = ["mine", "--difficulty", str(DIFF), "--blocks",
+                str(BLOCKS), "--backend", "tpu", "--kernel", "jnp",
+                "--batch-pow2", "10",
+                "--coordinator", f"127.0.0.1:{port}",
+                "--num-processes", str(n_processes)]
     if mesh_obs:
         # Every rank shards its telemetry; rank identity comes from
         # --process-id, so no extra env plumbing is needed.
@@ -75,9 +96,10 @@ def main(n_processes: int = 8, mesh_obs: str | None = None) -> int:
                 [sys.executable, "-c", _WRAPPER.format(argv=argv)],
                 env=env, cwd=str(REPO), stdout=subprocess.PIPE,
                 stderr=subprocess.PIPE, text=True))
+        rank_out: list[str] = []
         for p in procs:
             try:
-                _, err = p.communicate(timeout=350)
+                out, err = p.communicate(timeout=350)
             except subprocess.TimeoutExpired:
                 # Same one-line JSON error contract as the rc!=0 path;
                 # the finally below reaps every surviving rank.
@@ -86,6 +108,7 @@ def main(n_processes: int = 8, mesh_obs: str | None = None) -> int:
             if p.returncode != 0:
                 print(json.dumps({"error": err[-1500:]}))
                 return 1
+            rank_out.append(out)
     finally:
         # A timeout (or any failure) must not leak the surviving ranks —
         # a live rank holds the distributed world open and would wedge
@@ -96,17 +119,39 @@ def main(n_processes: int = 8, mesh_obs: str | None = None) -> int:
                 p.wait()
     wall = round(time.time() - t0, 1)
 
-    from mpi_blockchain_tpu.config import MinerConfig
-    from mpi_blockchain_tpu.models.miner import Miner
-    oracle = Miner(MinerConfig(difficulty_bits=DIFF, n_blocks=BLOCKS,
-                               backend="cpu"), log_fn=lambda d: None)
-    oracle.mine_chain()
     chain = pathlib.Path(out_file).read_bytes()
     summary = {
         "n_processes": n_processes, "difficulty": DIFF, "blocks": BLOCKS,
-        "wall_s": wall, "tip": oracle.node.tip_hash.hex(),
-        "identical_to_single_rank_oracle": chain == oracle.node.save(),
+        "wall_s": wall, "elastic": elastic,
     }
+    if elastic:
+        # Striped chains are rank-dependent by design: validate rank 0's
+        # artifact through the full C++ PoW+linkage loader (the cpu
+        # oracle's validation path) and collect every rank's membership.
+        from mpi_blockchain_tpu import core
+        oracle_node = core.Node(DIFF, 0)
+        summary["chain_valid_vs_oracle"] = bool(oracle_node.load(chain))
+        summary["chain_height"] = oracle_node.height
+        per_rank = {}
+        for rank, out in enumerate(rank_out):
+            lines = [ln for ln in out.splitlines() if ln.strip()]
+            try:
+                mesh = json.loads(lines[-1]).get("mesh") if lines else None
+            except json.JSONDecodeError:
+                mesh = None
+            if mesh is not None:
+                per_rank[str(rank)] = {"live": mesh["live"],
+                                       "evicted": mesh["evicted"]}
+        summary["elastic_membership"] = per_rank
+    else:
+        from mpi_blockchain_tpu.config import MinerConfig
+        from mpi_blockchain_tpu.models.miner import Miner
+        oracle = Miner(MinerConfig(difficulty_bits=DIFF, n_blocks=BLOCKS,
+                                   backend="cpu"), log_fn=lambda d: None)
+        oracle.mine_chain()
+        summary["tip"] = oracle.node.tip_hash.hex()
+        summary["identical_to_single_rank_oracle"] = \
+            chain == oracle.node.save()
     if mesh_obs:
         from mpi_blockchain_tpu.meshwatch import merge_shards, mesh_health
         from mpi_blockchain_tpu.meshwatch.aggregate import read_shards
@@ -137,5 +182,7 @@ def main(n_processes: int = 8, mesh_obs: str | None = None) -> int:
 
 
 if __name__ == "__main__":
-    sys.exit(main(int(sys.argv[1]) if len(sys.argv) > 1 else 8,
-                  sys.argv[2] if len(sys.argv) > 2 else None))
+    argv = [a for a in sys.argv[1:] if a != "--elastic"]
+    sys.exit(main(int(argv[0]) if len(argv) > 0 else 8,
+                  argv[1] if len(argv) > 1 else None,
+                  elastic="--elastic" in sys.argv[1:]))

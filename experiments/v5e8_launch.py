@@ -49,7 +49,8 @@ SINGLE_CHIP_PLATEAU_MHS = 970.0
 def launch(n_miners: int = 8, preset_overrides: dict | None = None,
            blocks_per_call: int = 500,
            expected_tip: str | None = PINNED_TIP_1000_D24,
-           mesh_obs: str | None = None) -> dict:
+           mesh_obs: str | None = None,
+           elastic: bool = False) -> dict:
     """Preflight + run config 4 on an n_miners mesh; returns the report.
 
     preset_overrides shrinks the run for the CI twin (difficulty,
@@ -60,6 +61,16 @@ def launch(n_miners: int = 8, preset_overrides: dict | None = None,
     for mesh-wide aggregation, and the report carries the dispatch
     pipeline's overlap/bubble numbers either way — the evidence the
     scale-out claim is judged against (docs/perfwatch.md §Pipeline).
+
+    ``elastic`` (or env MPIBT_ELASTIC) trades the fused loop for the
+    survivable per-block path (docs/resilience.md §Elastic mesh): every
+    sharded dispatch runs under the MPIBT_COLLECTIVE_TIMEOUT watchdog
+    via resilience.elastic.ElasticMeshBackend, and a chip whose
+    winner-select rendezvous wedges is evicted (the mesh rebuilds one
+    device smaller under the mesh.rebuild retry budget) instead of
+    hanging the 8-chip bring-up forever. The lowest-nonce rule makes
+    the result n_miners-invariant, so the PRE-REGISTERED tip assertion
+    holds unchanged even after a mid-run shrink.
     """
     import jax
 
@@ -103,13 +114,24 @@ def launch(n_miners: int = 8, preset_overrides: dict | None = None,
         cfg = dataclasses.replace(PRESETS["tpu-mesh8"], n_miners=n_miners,
                                   **(preset_overrides or {}))
         report["config"] = dataclasses.asdict(cfg)
-        miner = FusedMiner(cfg, blocks_per_call=blocks_per_call, mesh=mesh,
-                           log_fn=lambda d: None)
-        t0 = time.perf_counter()
-        miner.warmup()
-        if cfg.n_blocks % blocks_per_call:
-            miner.warmup(cfg.n_blocks % blocks_per_call)
-        report["compile_s"] = round(time.perf_counter() - t0, 3)
+        report["elastic"] = bool(elastic)
+        backend = None
+        if elastic:
+            from mpi_blockchain_tpu.models.miner import Miner
+            from mpi_blockchain_tpu.resilience.elastic import \
+                ElasticMeshBackend
+
+            backend = ElasticMeshBackend(cfg, mesh=mesh)
+            miner = Miner(cfg, backend=backend, log_fn=lambda d: None)
+            report["compile_s"] = None   # per-block path compiles lazily
+        else:
+            miner = FusedMiner(cfg, blocks_per_call=blocks_per_call,
+                               mesh=mesh, log_fn=lambda d: None)
+            t0 = time.perf_counter()
+            miner.warmup()
+            if cfg.n_blocks % blocks_per_call:
+                miner.warmup(cfg.n_blocks % blocks_per_call)
+            report["compile_s"] = round(time.perf_counter() - t0, 3)
 
         # ---- the run (config 4, literally) ------------------------------
         t0 = time.perf_counter()
@@ -144,6 +166,10 @@ def launch(n_miners: int = 8, preset_overrides: dict | None = None,
             "bubble_fraction": pipe["bubble_fraction"],
             "host_overlapped_fraction": pipe["host_overlapped_fraction"],
         }
+        if backend is not None:
+            # Did the elastic mesh shrink mid-run, and to how many
+            # chips? (The tip assertion below holds either way.)
+            report["elastic_mesh"] = backend.summary()
         if expected_tip is not None:
             report["tip_matches_preregistered"] = tip == expected_tip
             if tip != expected_tip:
@@ -170,12 +196,14 @@ def launch(n_miners: int = 8, preset_overrides: dict | None = None,
 
 
 def main() -> int:
+    elastic = "--elastic" in sys.argv[1:] or \
+        bool(os.environ.get("MPIBT_ELASTIC"))
     try:
         # SPMD003 suppressed with cause: this driver is single-process —
         # all 8 chips live in THIS process, so catching a failed launch
         # cannot strand peer ranks in a collective (there are none); the
         # multi-host path (parallel/distributed.py) stays unsuppressed.
-        report = launch()   # chainlint: disable=SPMD003
+        report = launch(elastic=elastic)   # chainlint: disable=SPMD003
     except RuntimeError as e:
         print(json.dumps({"event": "v5e8_launch", "ok": False,
                           "error": str(e),

@@ -181,7 +181,8 @@ FAMILY_SCOPES: dict[str, tuple[str, ...]] = {
     "telemetry": ("mpi_blockchain_tpu", "experiments"),
     "resilience": ("mpi_blockchain_tpu",),
     "conc": ("mpi_blockchain_tpu", "experiments"),
-    "spmd": ("mpi_blockchain_tpu/parallel", "experiments"),
+    "spmd": ("mpi_blockchain_tpu/parallel", "experiments",
+             "mpi_blockchain_tpu/resilience/elastic.py"),
     "hotpath": ("mpi_blockchain_tpu",),
     "opbudget": ("mpi_blockchain_tpu/ops", "OPBUDGET.json",
                  "experiments/roofline.py",

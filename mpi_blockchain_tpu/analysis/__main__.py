@@ -35,8 +35,8 @@ OVERRIDE_KEYS = ("capi", "ctypes_binding", "pybind", "chain_hpp",
                  "mesh_py", "core_makefile", "core_src", "sim_py",
                  "telemetry_files", "resilience_files",
                  "adversary_files", "rank_scope_files", "jax_files",
-                 "conc_files", "spmd_files", "hotpath_files",
-                 "opbudget_json", "kernel_src")
+                 "conc_files", "spmd_files", "elastic_files",
+                 "hotpath_files", "opbudget_json", "kernel_src")
 
 
 def _changed_files(root: pathlib.Path, rev: str) -> list[str] | None:

@@ -26,6 +26,21 @@ diverge:
            skips the collective that the other ranks are blocked in
            (a one-rank retry is a mesh-wide hang). Handlers that
            re-raise (cleanup idiom) are fine.
+  SPMD004  a collective/rendezvous in an ELASTIC file (override key
+           ``elastic_files``; default resilience/elastic.py) that does
+           not go through the ``guarded_collective`` helper — elastic
+           code is exactly the code that recovers from rank loss, so an
+           unguarded rendezvous there reintroduces the mesh-wide hang
+           the supervisor exists to prevent. A collective is guarded
+           when it sits inside a DEFERRED (lambda-wrapped) argument of
+           a ``guarded_collective(...)`` call, or inside a function
+           whose EVERY module-local call site does (one lexical hop —
+           the ``_rendezvous`` idiom); an eagerly-evaluated argument
+           (``guarded_collective(self._rendezvous(n))``, no lambda)
+           runs BEFORE the guard and is flagged. Elastic files are
+           exempt from
+           SPMD001-003: ``guarded_collective`` + watchdog recovery is
+           their sanctioned alternative to the re-raise discipline.
 
 "Collective" is detected directly (``lax.psum``/``pmin``/... ,
 ``jax.distributed.initialize``, the repo's ``init_distributed``) and by
@@ -248,6 +263,101 @@ def _axis_findings(rel: str, tree: ast.Module,
     return findings
 
 
+#: The sanctioned guard helpers SPMD004 recognizes (resilience/elastic).
+ELASTIC_GUARDS = {"guarded_collective"}
+
+
+class _ElasticWalker(ast.NodeVisitor):
+    """Collects every call site's guard status in an elastic file:
+    whether it sits inside a DEFERRED (lambda-wrapped) argument of a
+    ``guarded_collective(...)`` call. Deferral matters: in
+    ``guarded_collective(self._rendezvous(n))`` the rendezvous runs
+    eagerly in the caller's thread BEFORE the guard is even entered —
+    lexically inside the argument, but unguarded at runtime."""
+
+    def __init__(self):
+        self._fn_stack: list[str] = []
+        self._guard_depth = 0
+        self._deferred_depth = 0
+        #: (node, op label, innermost enclosing function, guarded)
+        self.collectives: list[tuple[ast.Call, str, str | None, bool]] = []
+        #: every call site: name -> [guarded?, ...]
+        self.call_sites: dict[str, list[bool]] = {}
+
+    def _guarded(self) -> bool:
+        # Inside a guard argument AND behind at least one lambda since
+        # entering it — only then does the code run on the guard's
+        # watchdogged worker rather than eagerly at the call site.
+        return self._guard_depth > 0 and self._deferred_depth > 0
+
+    def visit_FunctionDef(self, node):
+        self._fn_stack.append(node.name)
+        self.generic_visit(node)
+        self._fn_stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        deferred = self._guard_depth > 0
+        if deferred:
+            self._deferred_depth += 1
+        self.generic_visit(node)
+        if deferred:
+            self._deferred_depth -= 1
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = call_name(node)
+        self.call_sites.setdefault(name, []).append(self._guarded())
+        op = _is_collective_call(node)
+        if op is not None and not (set(self._fn_stack) & ELASTIC_GUARDS):
+            self.collectives.append(
+                (node, op, self._fn_stack[-1] if self._fn_stack else None,
+                 self._guarded()))
+        self.visit(node.func)
+        if name in ELASTIC_GUARDS:
+            self._guard_depth += 1
+        for arg in node.args:
+            self.visit(arg)
+        for kw in node.keywords:
+            self.visit(kw.value)
+        if name in ELASTIC_GUARDS:
+            self._guard_depth -= 1
+
+
+def _elastic_findings(rel: str, tree: ast.Module) -> list[Finding]:
+    """SPMD004: unguarded collectives in an elastic file. One lexical
+    hop is recognized: a collective inside function F is guarded when
+    EVERY module-local call site of F is itself inside a DEFERRED guard
+    argument (the ``guarded_collective(lambda: self._rendezvous(n))``
+    idiom — without the lambda the rendezvous runs eagerly before the
+    guard and is flagged); deeper indirection is out of scope, like the
+    call-graph builder's other known limits (docs/static_analysis.md)."""
+    walker = _ElasticWalker()
+    walker.visit(tree)
+    findings: list[Finding] = []
+    for node, op, enclosing, guarded in walker.collectives:
+        if guarded:
+            continue
+        if enclosing is not None:
+            sites = walker.call_sites.get(enclosing, [])
+            if sites and all(sites):
+                continue   # only ever reached through the guard
+        findings.append(Finding(
+            rel, node.lineno, "SPMD004",
+            f"collective/rendezvous '{op}' in an elastic file does not "
+            f"go through guarded_collective — elastic code is the "
+            f"rank-loss recovery path, and an unguarded rendezvous "
+            f"there can hang the survivors the supervisor exists to "
+            f"save; wrap the dispatch in guarded_collective(lambda: "
+            f"...) (one lexical hop is recognized)"))
+    return findings
+
+
+def _default_elastic_files(root: pathlib.Path) -> list[pathlib.Path]:
+    path = root / "mpi_blockchain_tpu" / "resilience" / "elastic.py"
+    return [path] if path.is_file() else []
+
+
 def _scoped_files(root: pathlib.Path) -> list[pathlib.Path]:
     files: list[pathlib.Path] = []
     par = root / "mpi_blockchain_tpu" / "parallel"
@@ -287,4 +397,20 @@ def run_spmd_lint(root: pathlib.Path, overrides=None,
         walker.visit(tree)
         if canonical:
             findings.extend(_axis_findings(rel, tree, canonical))
+    # SPMD004 scope: the elastic files, which are deliberately EXEMPT
+    # from SPMD001-003 (guarded_collective + watchdog recovery is their
+    # sanctioned alternative to the re-raise discipline).
+    for path in override_files(overrides, "elastic_files",
+                               lambda: _default_elastic_files(root)):
+        path = pathlib.Path(path)
+        rel = rel_path(path, root)
+        try:
+            tree = ast.parse(path.read_text(), filename=str(path))
+        except SyntaxError as e:
+            findings.append(Finding(rel, e.lineno or 1, "SPMD000",
+                                    f"syntax error: {e.msg}"))
+            continue
+        except OSError:
+            continue
+        findings.extend(_elastic_findings(rel, tree))
     return findings

@@ -159,6 +159,24 @@ def _load_resume(path: str, cfg, mesh):
     return node, err, report
 
 
+def _mesh_identity(args) -> "tuple[int, int]":
+    """This process's (rank, world_size): the multi-process launch flags
+    win; standalone ranks (one process per rank, no coordinator) are
+    labeled via MPIBT_MESH_RANK / MPIBT_MESH_WORLD by whatever launched
+    them. The single resolution point shared by the meshwatch shard
+    writer and ElasticWorld, so the rank a process supervises AS is
+    always the rank the oracle observes it UNDER."""
+    from .telemetry.events import env_number as _env_number
+
+    rank = getattr(args, "process_id", None)
+    if rank is None:
+        rank = _env_number("MPIBT_MESH_RANK", 0, cast=int, minimum=0)
+    world = getattr(args, "num_processes", None)
+    if world is None:
+        world = _env_number("MPIBT_MESH_WORLD", 1, cast=int, minimum=1)
+    return rank, world
+
+
 def cmd_mine(args) -> int:
     import contextlib
 
@@ -168,16 +186,46 @@ def cmd_mine(args) -> int:
     cfg = _config_from(args)
     if args.verbose:
         get_logger().setLevel("DEBUG")
-    cfg, mesh, is_main = _init_world(args, cfg)
-    if args.fused:
-        from .models.fused import FusedMiner
-        miner = FusedMiner(cfg, blocks_per_call=args.blocks_per_call,
-                           mesh=mesh)
-    elif mesh is not None:   # _init_world forces backend="tpu" with a mesh
-        from .backend import backend_from_config
-        miner = Miner(cfg, backend=backend_from_config(cfg, mesh=mesh))
+    world = None
+    if args.elastic:
+        if args.coordinator:
+            raise ConfigError(
+                "--elastic cannot ride a jax.distributed world (its "
+                "size is pinned at init and cannot shrink); run elastic "
+                "ranks as independent processes sharing --mesh-obs")
+        if args.fused:
+            raise ConfigError(
+                "--elastic needs the per-block miner (drop --fused): "
+                "the fused device loop has no per-block supervision "
+                "point to evict and re-stripe at")
+        from .resilience.elastic import (ElasticMeshBackend, ElasticMiner,
+                                         ElasticWorld)
+        rank, world_size = _mesh_identity(args)
+        obs = args.mesh_obs or os.environ.get("MPIBT_MESH_OBS") or None
+        if world_size > 1 and obs is None:
+            # Without the shard oracle the supervisor is detection-blind:
+            # a SIGKILL'd peer is never evicted and its stripes never
+            # re-covered. Seeded mesh.rank_death plans still work (the
+            # plan itself names the deaths), so warn rather than refuse.
+            print("elastic: multi-rank world has no --mesh-obs/"
+                  "MPIBT_MESH_OBS shard oracle — dead peers will not be "
+                  "detected or evicted", file=sys.stderr, flush=True)
+        world = ElasticWorld(world_size, rank, obs_dir=obs)
+        backend = (ElasticMeshBackend(cfg)
+                   if cfg.backend == "tpu" and cfg.n_miners > 1 else None)
+        miner = ElasticMiner(cfg, world, backend=backend)
+        mesh, is_main = None, True
     else:
-        miner = Miner(cfg)
+        cfg, mesh, is_main = _init_world(args, cfg)
+        if args.fused:
+            from .models.fused import FusedMiner
+            miner = FusedMiner(cfg, blocks_per_call=args.blocks_per_call,
+                               mesh=mesh)
+        elif mesh is not None:   # _init_world forces backend="tpu" here
+            from .backend import backend_from_config
+            miner = Miner(cfg, backend=backend_from_config(cfg, mesh=mesh))
+        else:
+            miner = Miner(cfg)
     if args.resume:
         node, err, report = _load_resume(args.resume, cfg, mesh)
         if err is not None:
@@ -185,6 +233,12 @@ def cmd_mine(args) -> int:
                              sort_keys=True))
             return 1
         miner.node = node
+        if world is not None and report.get("mesh"):
+            # The sidecar's membership restores the SHRUNKEN world: a
+            # resumed survivor keeps its re-striped share instead of
+            # re-assuming the seed world (and re-overlapping stripes
+            # the survivors already re-covered).
+            world.restore(report["mesh"])
         # Replay the progress heartbeat at the resumed height BEFORE the
         # first (possibly slow) sweep, so perfwatch /healthz sees the
         # recovery as live progress, not a stall inherited from the
@@ -230,8 +284,10 @@ def cmd_mine(args) -> int:
             if rec.height % every == 0:
                 with _profiler().segment_on_last("checkpoint"):
                     call_with_retry(
-                        lambda: _periodic_save(miner.node, args.checkpoint,
-                                               cfg),
+                        lambda: _periodic_save(
+                            miner.node, args.checkpoint, cfg,
+                            mesh=(world.membership() if world is not None
+                                  else None)),
                         site="checkpoint.write")
         if not is_main:
             # Multi-process world: every rank mines the identical chain,
@@ -263,7 +319,9 @@ def cmd_mine(args) -> int:
             f.write(miner.node.save())
     if args.checkpoint:
         from .utils.checkpoint import save_chain
-        save_chain(miner.node, args.checkpoint, cfg)
+        save_chain(miner.node, args.checkpoint, cfg,
+                   mesh=(world.membership() if world is not None
+                         else None))
     summary = {
         "event": "chain_mined",
         "config": dataclasses.asdict(cfg),
@@ -276,6 +334,20 @@ def cmd_mine(args) -> int:
         summary.update(hashes_tried=miner.total_hashes(),
                        hashes_per_sec=round(miner.hashes_per_sec()),
                        backend=miner.backend.name)
+    if world is not None:
+        summary["mesh"] = world.summary()
+        if hasattr(miner.backend, "n_live"):   # ElasticMeshBackend
+            summary["mesh"]["device_mesh"] = miner.backend.summary()
+        if getattr(args, "events_dump", None):
+            # Like sim's --events-dump: a dump failure must not mask
+            # the run's own outcome.
+            try:
+                world.dump_causal(args.events_dump,
+                                  meta={"target_blocks": cfg.n_blocks,
+                                        "difficulty_bits":
+                                            cfg.difficulty_bits})
+            except OSError as e:
+                print(f"events-dump failed: {e}", file=sys.stderr)
     degradations = getattr(getattr(miner, "backend", None),
                            "degradations", [])
     if degradations:
@@ -612,6 +684,24 @@ def main(argv: list[str] | None = None) -> int:
     p_mine.add_argument("--profile",
                         help="capture a jax.profiler device trace into this "
                              "logdir (view with ui.perfetto.dev)")
+    p_mine.add_argument("--elastic", action="store_true",
+                        help="rank-death survival (docs/resilience.md "
+                             "§Elastic mesh): this rank sweeps its stripe "
+                             "of the nonce space, evicts confirmed-dead "
+                             "peers via the --mesh-obs shard oracle, "
+                             "re-stripes over the survivors and keeps "
+                             "mining; with a multi-device tpu backend the "
+                             "sharded dispatch additionally runs under "
+                             "the MPIBT_COLLECTIVE_TIMEOUT watchdog and "
+                             "the mesh shrinks on suspicion (rank/world "
+                             "from --process-id/--num-processes or "
+                             "MPIBT_MESH_RANK/MPIBT_MESH_WORLD)")
+    p_mine.add_argument("--events-dump", metavar="PATH", default=None,
+                        help="with --elastic: write this rank's Lamport-"
+                             "stamped causal log (mined blocks + "
+                             "membership transitions) to PATH on exit — "
+                             "byte-identical across same-seed "
+                             "mesh.rank_death runs")
     _add_metrics_dump_arg(p_mine)
     p_mine.add_argument("--coordinator",
                         help="multi-process launch: coordinator host:port "
@@ -777,17 +867,8 @@ def main(argv: list[str] | None = None) -> int:
     exit_status: int | str = "error"
     if mesh_obs:
         from .meshwatch import shard as _mesh_shard
-        from .telemetry.events import env_number as _env_number
 
-        # Rank identity: the multi-process launch flag wins; standalone
-        # ranks (one process per rank, no coordinator) are labeled via
-        # MPIBT_MESH_RANK / MPIBT_MESH_WORLD by whatever launched them.
-        rank = getattr(args, "process_id", None)
-        if rank is None:
-            rank = _env_number("MPIBT_MESH_RANK", 0, cast=int, minimum=0)
-        world = getattr(args, "num_processes", None)
-        if world is None:
-            world = _env_number("MPIBT_MESH_WORLD", 1, cast=int, minimum=1)
+        rank, world = _mesh_identity(args)
         try:
             _mesh_shard.install(mesh_obs, rank=rank, world_size=world)
         except OSError as e:

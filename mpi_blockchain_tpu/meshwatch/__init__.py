@@ -32,7 +32,8 @@ importing this package never pulls in jax.
 from __future__ import annotations
 
 from .aggregate import (merge_shards, mesh_health,  # noqa: F401
-                        read_shards, render_mesh_prometheus)
+                        rank_status, read_shards,
+                        recommended_action, render_mesh_prometheus)
 from .pipeline import (PipelineProfiler, pipeline_report,  # noqa: F401
                        profiler, reset_profiler, to_chrome_trace)
 from .shard import ShardWriter, install, installed, uninstall  # noqa: F401

@@ -145,6 +145,31 @@ def merge_shards(shards: list[dict]) -> dict:
 # ---- rank liveness --------------------------------------------------------
 
 
+def recommended_action(status: str, stale_reason: str | None = None) -> str:
+    """The machine-readable recovery verdict for one rank's
+    classification — the ONE mapping the elastic supervisor
+    (resilience/elastic.py) and every ``/healthz`` reader share, so
+    "what should happen to this rank" is decided once, not per caller:
+
+    * ``none``    — ``ok``/``finished``: leave it alone.
+    * ``restart`` — ``stale`` with ``no-progress``: the process is
+      ALIVE (its shard flusher still writes) but the work is wedged —
+      restarting it is the remedy; evicting a live rank that later
+      recovers would re-overlap the stripes it still sweeps.
+    * ``evict``   — ``stale`` with ``dead-shard`` (the process is gone:
+      SIGKILL, OOM), ``failed`` (it exited deliberately and badly — it
+      left the mesh), or ``missing`` (expected, never wrote a shard;
+      the supervisor applies its own startup grace before acting).
+    """
+    if status in ("ok", "finished"):
+        return "none"
+    if status == "stale":
+        return "restart" if stale_reason == "no-progress" else "evict"
+    if status in ("failed", "missing"):
+        return "evict"
+    return "none"
+
+
 def rank_status(shards: list[dict], stall_s: float | None = None,
                 now: float | None = None,
                 heartbeat_stall_s: float | None = None) -> dict:
@@ -186,11 +211,13 @@ def rank_status(shards: list[dict], stall_s: float | None = None,
                 # Running that long without EVER heartbeating: wedged
                 # before its first unit of work (a hung device init).
                 stale_reason = "no-progress"
+        state = ("failed" if failed
+                 else "finished" if final
+                 else "stale" if stale_reason else "ok")
         ranks[rank] = {
-            "status": ("failed" if failed
-                       else "finished" if final
-                       else "stale" if stale_reason else "ok"),
+            "status": state,
             "stale_reason": stale_reason,
+            "recommended_action": recommended_action(state, stale_reason),
             "final": final,
             "exit_status": exit_status,
             "shard_age_s": round(shard_age, 3),
@@ -203,7 +230,10 @@ def rank_status(shards: list[dict], stall_s: float | None = None,
     for rank in range(world):
         if rank not in present:
             ranks[str(rank)] = {"status": "missing",
-                                "stale_reason": None, "final": False,
+                                "stale_reason": None,
+                                "recommended_action":
+                                    recommended_action("missing"),
+                                "final": False,
                                 "exit_status": None,
                                 "shard_age_s": None,
                                 "heartbeat_age_s": None,

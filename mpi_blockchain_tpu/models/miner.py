@@ -47,6 +47,15 @@ class Miner:
         self.records: list[BlockRecord] = []
         self._log = log_fn if log_fn is not None else block_logger()
 
+    def search_windows(self):
+        """The ascending ``(start, end)`` nonce windows each candidate
+        sweep covers, searched in order until one holds a qualifier.
+        The default miner owns the whole uint32 space in one window —
+        behavior identical to the pre-seam loop. The elastic striped
+        world (resilience/elastic.ElasticMiner) overrides this with its
+        rank's re-stripeable share of the space."""
+        return ((0, 1 << 32),)
+
     def mine_block(self, data: bytes | None = None) -> BlockRecord:
         """Mines and appends exactly one block on the current tip.
 
@@ -77,21 +86,40 @@ class Miner:
                 with prec.segment("enqueue"):
                     cand = self.node.make_candidate(
                         extend_payload(data, extra_nonce))
+                res = None
                 with span("miner.sweep", height=height,
                           extra_nonce=extra_nonce), \
                         prec.segment("device"):
-                    res = self.backend.search(cand,
-                                              self.config.difficulty_bits)
-                counter("mining_rounds_total",
-                        help="backend sweep rounds issued",
-                        backend=backend).inc()
-                # One stamp per sweep round, so a wedged backend stalls
-                # the /healthz watchdog.
-                heartbeat("miner_heartbeat").set(self.node.height)
-                counter("hashes_tried_total",
-                        help="nonces evaluated across all sweeps",
-                        backend=backend).inc(res.hashes_tried)
-                tried += res.hashes_tried
+                    # Windows ascend, so the first one holding a
+                    # qualifier yields the lowest nonce in this miner's
+                    # assigned space — the same determinism rule, per
+                    # window set.
+                    for w_start, w_end in self.search_windows():
+                        res = self.backend.search(
+                            cand, self.config.difficulty_bits,
+                            start_nonce=w_start,
+                            max_count=w_end - w_start)
+                        # One inc per backend.search call — for a striped
+                        # elastic miner that is one per window, keeping
+                        # hashes_tried_total / mining_rounds_total an
+                        # honest per-sweep ratio.
+                        counter("mining_rounds_total",
+                                help="backend sweep rounds issued",
+                                backend=backend).inc()
+                        counter("hashes_tried_total",
+                                help="nonces evaluated across all sweeps",
+                                backend=backend).inc(res.hashes_tried)
+                        tried += res.hashes_tried
+                        # One stamp per window sweep (the whole space
+                        # for the default miner, one stripe slice for
+                        # the elastic one), so a wedged backend stalls
+                        # the /healthz watchdog even mid-candidate.
+                        heartbeat("miner_heartbeat").set(self.node.height)
+                        if res.nonce is not None:
+                            break
+                if res is None:
+                    raise RuntimeError(
+                        "search_windows yielded no nonce windows")
                 if res.nonce is not None:
                     break
                 self._log({"event": "nonce_space_exhausted",

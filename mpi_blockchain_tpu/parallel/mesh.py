@@ -197,6 +197,39 @@ def sharded_local_base(base, batch_size: int, axis_name: str = "miners"):
     return jnp.asarray(base).astype(_U32) + i * np.uint32(batch_size)
 
 
+#: The full uint32 nonce space every striping scheme must tile exactly.
+NONCE_SPACE = 1 << 32
+
+
+def stripe_windows(index: int, n_live: int, batch_size: int,
+                   space: int = NONCE_SPACE):
+    """The nonce windows the dense-``index``-th of ``n_live`` live ranks
+    sweeps, ascending — the HOST-side twin of ``sharded_local_base``:
+    round r covers the contiguous range [r*n_live*B, +n_live*B) and the
+    index-th rank owns its B-sized slice of every round, so the union of
+    all live ranks' windows is EXACTLY [0, space) with no gap and no
+    overlap (the elastic re-stripe invariant; property-tested in
+    tests/test_elastic.py for every world_size <= 8 x dead-subset pair).
+
+    Yields ``(start, end)`` pairs. n_live == 1 yields one full-space
+    window (no reason to chop a lone rank's sweep into round slices).
+    Keeping this next to ``sharded_local_base`` is deliberate: they
+    encode the same striping rule and must change together.
+    """
+    if not 0 <= index < n_live:
+        raise ConfigError(f"stripe index {index} out of range for "
+                          f"{n_live} live rank(s)")
+    if batch_size < 1 or space < 1:
+        raise ConfigError(f"stripe batch_size/space must be >= 1, got "
+                          f"{batch_size}/{space}")
+    if n_live == 1:
+        yield (0, space)
+        return
+    round_size = n_live * batch_size
+    for base in range(index * batch_size, space, round_size):
+        yield (base, min(base + batch_size, space))
+
+
 def winner_select(count, min_nonce, axis_name: str = "miners"):
     """The reference's MPI_Bcast/allreduce as ICI collectives: psum the
     qualifier count, pmin the per-device min qualifying nonce (0xFFFFFFFF

@@ -56,6 +56,22 @@ class FaultPlanError(ConfigError):
     unknown site/kind, or — under ``strict`` — faults that never fired."""
 
 
+class RankLossSuspected(RuntimeError):
+    """A guarded collective/rendezvous exceeded its watchdog budget (or
+    an injected ``parallel.collective`` fault fired): a peer rank is
+    suspected dead and the survivor must consult the meshwatch oracle
+    and shrink instead of hanging forever (resilience/elastic.py)."""
+
+    def __init__(self, site: str, elapsed_s: float | None = None,
+                 message: str = ""):
+        self.site = site
+        self.elapsed_s = elapsed_s
+        super().__init__(
+            message or f"collective at {site} exceeded its watchdog"
+            + (f" after {elapsed_s:.3f}s" if elapsed_s is not None else "")
+            + " — peer rank loss suspected")
+
+
 class RetryExhausted(RuntimeError):
     """A policy-wrapped call failed on every attempt and every ladder
     rung below it (CLI rc 2). ``last`` keeps the final cause."""

@@ -151,6 +151,181 @@ def cmd_smoke(args) -> int:
     return 0
 
 
+# ---- the elastic-smoke gate (make elastic-smoke) ---------------------------
+
+
+def _spawn_elastic_rank(rank: int, world: int, tmp: pathlib.Path,
+                        argv_extra: list[str],
+                        env_extra: dict | None = None):
+    repo_root = pathlib.Path(__file__).resolve().parents[2]
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=os.pathsep.join(
+                   p for p in (str(repo_root),
+                               os.environ.get("PYTHONPATH")) if p),
+               **(env_extra or {}))
+    argv = [sys.executable, "-m", "mpi_blockchain_tpu", "mine",
+            "--backend", "cpu", "--elastic",
+            "--process-id", str(rank), "--num-processes", str(world)]
+    return subprocess.Popen(argv + argv_extra, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True, env=env,
+                            cwd=str(tmp))
+
+
+def _last_json(out: str) -> dict:
+    lines = [ln for ln in out.splitlines() if ln.strip()]
+    return json.loads(lines[-1]) if lines else {}
+
+
+def smoke_elastic_sigkill(tmp: pathlib.Path) -> str:
+    """Phase 1: a 4-rank striped world; rank 2 is SIGKILL'd once its
+    shard PROVES a miner heartbeat in flight. The survivors must evict
+    it via meshwatch shard staleness (dead-shard — not a timeout
+    guess), re-stripe over [0, 1, 3], finish rc 0, and rank 0's chain
+    must pass the cpu oracle's full C++ PoW+linkage validation."""
+    import signal
+    import time
+
+    from ..meshwatch.aggregate import read_shards
+    from ..meshwatch.shard import shard_path
+    from .. import core
+
+    world, victim = 4, 2
+    obs = tmp / "mesh_sigkill"
+    chain = tmp / "elastic_chain.bin"
+    # Self-calibrate the survivor workload to ~12 s of mining on THIS
+    # machine, so the staleness eviction (a few seconds in) always lands
+    # while survivors are still mining — CI hosts span >10x in hash
+    # rate, and rank processes additionally share cores.
+    t0 = time.perf_counter()
+    _, probed = core.cpu_search(bytes(range(80)), 0, 1 << 20, 40)
+    rate = probed / max(time.perf_counter() - t0, 1e-9)
+    n_blocks = max(12, min(600, int(12.0 * rate / (1 << 18))))
+    # Stall budget 2 s against a 0.2 s flush cadence: wide enough that a
+    # LIVE survivor's flusher starved by CPU oversubscription (4 ranks
+    # on a 2-core CI box) is never mistaken for the corpse — only the
+    # SIGKILL'd rank, whose shard stops forever, ages past it. The
+    # missing-rank grace is parked far beyond the run: every rank writes
+    # a shard here, so a missing-eviction could only ever be a misfire.
+    env = {"MPIBT_MESH_OBS_INTERVAL": "0.2", "MPIBT_MESH_STALL": "2.0",
+           "MPIBT_ELASTIC_GRACE": "600"}
+    survivors = {
+        r: _spawn_elastic_rank(
+            r, world, tmp,
+            ["--difficulty", "18", "--blocks", str(n_blocks),
+             "--mesh-obs", str(obs)]
+            + (["--out", str(chain)] if r == 0 else []), env)
+        for r in range(world) if r != victim}
+    # The victim mines a much harder chain, so it is mid-sweep (stamping
+    # a heartbeat per stripe window) when the signal lands.
+    victim_proc = _spawn_elastic_rank(
+        victim, world, tmp,
+        ["--difficulty", "24", "--blocks", "1000",
+         "--mesh-obs", str(obs)], env)
+    try:
+        deadline = time.monotonic() + 120
+        vpath = shard_path(obs, victim)
+        while time.monotonic() < deadline:
+            shards = {s["rank"]: s for s in read_shards(obs)}
+            beats = shards.get(victim, {}).get("heartbeats", {})
+            if vpath.exists() and any("miner_heartbeat" in k
+                                      for k in beats):
+                break
+            time.sleep(0.1)
+        else:
+            raise AssertionError("victim never heartbeat")
+        victim_proc.send_signal(signal.SIGKILL)
+        victim_proc.wait(timeout=30)
+        summaries = {}
+        for r, p in survivors.items():
+            out, err = p.communicate(timeout=300)
+            assert p.returncode == 0, \
+                f"survivor rank {r} rc={p.returncode}: {err[-800:]}"
+            summaries[r] = _last_json(out)
+    finally:
+        for p in list(survivors.values()) + [victim_proc]:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    for r, summary in summaries.items():
+        mesh = summary.get("mesh") or {}
+        assert mesh.get("live") == [0, 1, 3], (r, mesh)
+        ev = {e["rank"]: e["reason"] for e in mesh.get("evicted", [])}
+        assert ev.get(victim) == "dead-shard", (r, mesh)
+    # The final chain verifies against the cpu oracle (full C++
+    # re-validation of every block: PoW + linkage).
+    assert core.Node(18, 0).load(chain.read_bytes()), \
+        "survivor chain failed oracle validation"
+    return (f"elastic sigkill ok (victim {victim} evicted via "
+            f"dead-shard staleness by all survivors; {n_blocks} blocks "
+            f"each; rank-0 chain oracle-valid)")
+
+
+def smoke_elastic_determinism(tmp: pathlib.Path) -> str:
+    """Phase 2: the seeded ``mesh.rank_death`` fault plan — the victim
+    hard-exits (rc 137, no final shard, like SIGKILL) at a plan-chosen
+    block step while every survivor evicts it at the SAME step; two
+    same-seed runs must produce byte-identical causal dumps."""
+    world = 4
+    plan_path = tmp / "rank_death.json"
+    plan_path.write_text(json.dumps({"version": 1, "seed": 9, "faults": [
+        {"site": "mesh.rank_death", "kind": "partial", "call": 2}]}))
+    runs: list[dict] = []
+    for run in range(2):
+        procs = {
+            r: _spawn_elastic_rank(
+                r, world, tmp,
+                ["--difficulty", "12", "--blocks", "8",
+                 "--batch-pow2", "12",
+                 "--fault-plan", str(plan_path),
+                 "--events-dump", str(tmp / f"run{run}_r{r}.json")])
+            for r in range(world)}
+        rcs, summaries = {}, {}
+        try:
+            for r, p in procs.items():
+                out, err = p.communicate(timeout=240)
+                rcs[r] = p.returncode
+                summaries[r] = _last_json(out) if p.returncode == 0 else {}
+        finally:
+            for p in procs.values():
+                if p.poll() is None:
+                    p.kill()
+                    p.wait()
+        victims = [r for r, rc in rcs.items() if rc == 137]
+        assert len(victims) == 1, f"run {run}: exit codes {rcs}"
+        victim = victims[0]
+        assert victim != 0, "the anchor rank must never be the victim"
+        assert all(rc == 0 for r, rc in rcs.items() if r != victim), rcs
+        for r, summary in summaries.items():
+            if r == victim:
+                continue
+            mesh = summary.get("mesh") or {}
+            ev = [(e["rank"], e["reason"], e["height"])
+                  for e in mesh.get("evicted", [])]
+            assert ev == [(victim, "rank_death", 3)], (r, mesh)
+            assert victim not in mesh.get("live", []), (r, mesh)
+        runs.append({"victim": victim})
+    assert runs[0]["victim"] == runs[1]["victim"]
+    victim = runs[0]["victim"]
+    for r in range(world):
+        d0, d1 = tmp / f"run0_r{r}.json", tmp / f"run1_r{r}.json"
+        if r == victim:
+            # os._exit skips the dump path — exactly like SIGKILL.
+            assert not d0.exists() and not d1.exists(), r
+            continue
+        assert d0.read_bytes() == d1.read_bytes(), \
+            f"rank {r}: same-seed mesh.rank_death dumps diverge"
+    return (f"elastic determinism ok (seeded victim {victim} died at "
+            f"step 3 in both runs; survivor causal dumps byte-identical)")
+
+
+def cmd_elastic_smoke(args) -> int:
+    with tempfile.TemporaryDirectory() as td:
+        tmp = pathlib.Path(td)
+        for phase in (smoke_elastic_sigkill, smoke_elastic_determinism):
+            print(f"elastic-smoke: {phase(tmp)}", flush=True)
+    return 0
+
+
 def cmd_plan(args) -> int:
     from .faultplan import FaultPlan
     print(json.dumps(FaultPlan.from_seed(args.seed,
@@ -168,6 +343,13 @@ def main(argv: list[str] | None = None) -> int:
     p_smoke = sub.add_parser("smoke", help="run the chaos-smoke gate "
                                            "(make chaos-smoke)")
     p_smoke.set_defaults(fn=cmd_smoke)
+    p_elastic = sub.add_parser(
+        "elastic-smoke",
+        help="run the elastic-mesh gate (make elastic-smoke): 4-rank "
+             "striped world, one rank SIGKILL'd -> staleness eviction + "
+             "re-stripe + rc 0, plus byte-identical same-seed "
+             "mesh.rank_death runs")
+    p_elastic.set_defaults(fn=cmd_elastic_smoke)
     p_plan = sub.add_parser("plan", help="print the plan --fault-plan "
                                          "seed:N would arm")
     p_plan.add_argument("--seed", type=int, default=0)

@@ -46,6 +46,20 @@ SITES = (
     #                           a fired fault crash-restarts a
     #                           seeded-chosen live node (corrupt/partial
     #                           damage kinds; raise/hang crash the step)
+    "parallel.collective",    # resilience/elastic.guarded_collective,
+    #                           per guarded rendezvous: raise/hang both
+    #                           surface as RankLossSuspected — the
+    #                           deterministic stand-in for a peer dying
+    #                           mid-psum/pmin (corrupt/partial behave
+    #                           like raise: a damaged collective result
+    #                           is indistinguishable from a lost peer)
+    "mesh.rank_death",        # resilience/elastic.ElasticWorld.step,
+    #                           once per block: a fired damage fault
+    #                           hard-exits the seeded-chosen victim rank
+    #                           (os._exit — no final shard, like
+    #                           SIGKILL) while every survivor evicts it
+    #                           at the same step (raise/hang crash the
+    #                           step as usual)
 )
 
 KINDS = ("raise", "hang", "corrupt", "partial")

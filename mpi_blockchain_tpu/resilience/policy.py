@@ -65,8 +65,18 @@ _PER_SITE: dict[str, RetryPolicy] = {
                                     max_backoff_s=0.1),
     "checkpoint.read": RetryPolicy(max_attempts=2, base_backoff_s=0.02,
                                    max_backoff_s=0.1),
+    # Rebuilding the miner mesh over the survivors of a rank loss: a
+    # short leash — the elastic supervisor must either shrink quickly or
+    # give up loudly, not camp on a fabric that keeps wedging.
+    "mesh.rebuild": RetryPolicy(max_attempts=2, base_backoff_s=0.05,
+                                max_backoff_s=0.5),
 }
 _DEFAULT = RetryPolicy()
+
+#: Site-specific attempt knobs (docs/resilience.md): unlike the global
+#: MPIBT_MAX_RETRIES cap these can RAISE a site's budget too (an 8-chip
+#: bring-up may want more mesh-rebuild patience than CI's default 2).
+_SITE_ENV_ATTEMPTS = {"mesh.rebuild": "MPIBT_MESH_REBUILD_RETRIES"}
 
 
 def policy_for(site: str, seed: int = 0) -> RetryPolicy:
@@ -76,9 +86,13 @@ def policy_for(site: str, seed: int = 0) -> RetryPolicy:
 
     base = _PER_SITE.get(site) or _PER_SITE.get(site.split(".", 1)[0],
                                                 _DEFAULT)
+    attempts = base.max_attempts
+    site_env = _SITE_ENV_ATTEMPTS.get(site) \
+        or _SITE_ENV_ATTEMPTS.get(site.split(".", 1)[0])
+    if site_env:
+        attempts = env_number(site_env, attempts, cast=int, minimum=1)
     cap = env_number(_ENV_MAX_ATTEMPTS, None, cast=int, minimum=1)
-    attempts = base.max_attempts if cap is None else min(base.max_attempts,
-                                                         cap)
+    attempts = attempts if cap is None else min(attempts, cap)
     if attempts == base.max_attempts and seed == base.seed:
         return base
     return dataclasses.replace(base, max_attempts=attempts, seed=seed)

@@ -118,10 +118,15 @@ def split_trailer(blob: bytes) -> tuple[bytes, bool]:
 
 def save_chain(node: core.Node, path: str | pathlib.Path,
                config: MinerConfig | dict | None = None,
-               fsync: bool = True) -> pathlib.Path:
+               fsync: bool = True,
+               mesh: dict | None = None) -> pathlib.Path:
     """Atomically writes the chain checkpoint + sidecar; returns path.
     ``config`` may be a MinerConfig or an already-serialized config dict
-    (the recovery rewrite preserves the original sidecar's)."""
+    (the recovery rewrite preserves the original sidecar's). ``mesh``
+    is the elastic world's membership payload (world_size / live /
+    evicted — resilience/elastic.ElasticWorld.membership): it rides the
+    sealed sidecar so ``--resume`` restores the SHRUNKEN world instead
+    of re-assuming the seed world (docs/resilience.md §Elastic mesh)."""
     from ..resilience import FaultInjected
     from ..telemetry import counter
     from ..telemetry.events import emit_event
@@ -148,6 +153,8 @@ def save_chain(node: core.Node, path: str | pathlib.Path,
     if config is not None:
         meta["config"] = (config if isinstance(config, dict)
                           else dataclasses.asdict(config))
+    if mesh is not None:
+        meta["mesh"] = dict(mesh)
     _atomic_write(path, blob, fsync=fsync)
     _atomic_write(_sidecar_path(path),
                   json.dumps(meta, sort_keys=True).encode(), fsync=fsync)
@@ -273,8 +280,12 @@ def recover_chain(path: str | pathlib.Path, difficulty_bits: int,
     path = pathlib.Path(path)
     try:
         node = load_chain(path, difficulty_bits, node_id)
+        # load_chain already validated the sidecar, so this re-read
+        # cannot raise; the mesh membership (if any) travels with the
+        # report so --resume can restore a shrunken elastic world.
+        meta = _read_sidecar(path) or {}
         return node, {"recovered": False, "height": node.height,
-                      "dropped_bytes": 0}
+                      "dropped_bytes": 0, "mesh": meta.get("mesh")}
     except CheckpointError as damage:
         blob = path.read_bytes()
         try:
@@ -288,9 +299,11 @@ def recover_chain(path: str | pathlib.Path, difficulty_bits: int,
                     blob[-TRAILER_SIZE:-40] == MAGIC:
                 payload = blob[:-TRAILER_SIZE]
         try:
-            config = (_read_sidecar(path) or {}).get("config")
+            meta = _read_sidecar(path) or {}
         except CheckpointError:
-            config = None    # sidecar itself corrupt: nothing to keep
+            meta = {}        # sidecar itself corrupt: nothing to keep
+        config = meta.get("config")
+        mesh_meta = meta.get("mesh")
         usable = payload[:len(payload) - len(payload) % core.HEADER_SIZE]
         for k in range(len(usable) // core.HEADER_SIZE, 0, -1):
             node = core.Node(difficulty_bits, node_id)
@@ -308,8 +321,10 @@ def recover_chain(path: str | pathlib.Path, difficulty_bits: int,
                             "dropped_bytes": dropped,
                             "damage": str(damage)})
                 # Rewrite the repaired artifact, preserving the original
-                # sidecar's recorded run config when it survived.
-                save_chain(node, path, config)
+                # sidecar's recorded run config AND elastic mesh
+                # membership when they survived.
+                save_chain(node, path, config, mesh=mesh_meta)
                 return node, {"recovered": True, "height": node.height,
-                              "dropped_bytes": dropped}
+                              "dropped_bytes": dropped,
+                              "mesh": mesh_meta}
         raise

@@ -920,6 +920,159 @@ def test_spmd_cli_pass_family(tmp_path):
     assert "SPMD001" in proc.stdout
 
 
+# ---- SPMD004: unguarded collectives in elastic files -------------------
+
+
+BAD_ELASTIC = textwrap.dedent("""\
+    import jax
+    from mpi_blockchain_tpu.parallel.mesh import make_miner_mesh
+    from mpi_blockchain_tpu.resilience.elastic import guarded_collective
+
+
+    def naked_winner(count):
+        return jax.lax.psum(count, "miners")          # SPMD004
+
+
+    def naked_rebuild(n):
+        return make_miner_mesh(n)                     # SPMD004
+
+
+    def guarded_rebuild(n):
+        return guarded_collective(lambda: make_miner_mesh(n),
+                                  site="mesh.rebuild")
+    """)
+
+
+def _elastic(tmp_path, text):
+    from mpi_blockchain_tpu.analysis.spmd_lint import run_spmd_lint
+
+    path = tmp_path / "elastic_mod.py"
+    path.write_text(text)
+    return run_spmd_lint(ROOT, overrides={"elastic_files": [path],
+                                          "spmd_files": [],
+                                          "mesh_py": MESH_PY})
+
+
+def test_spmd004_unguarded_collectives_fire(tmp_path):
+    findings = _elastic(tmp_path, BAD_ELASTIC)
+    assert [f.rule for f in findings] == ["SPMD004", "SPMD004"], \
+        "\n".join(f.render() for f in findings)
+    msgs = [f.message for f in findings]
+    assert any("psum" in m for m in msgs)
+    assert any("make_miner_mesh" in m for m in msgs)
+
+
+def test_spmd004_one_hop_rendezvous_idiom(tmp_path):
+    """A collective inside a function whose EVERY module-local call site
+    sits in a guard argument is clean (the ``_rendezvous`` idiom); one
+    unguarded call site re-arms the finding."""
+    clean = textwrap.dedent("""\
+        import jax
+        from mpi_blockchain_tpu.resilience.elastic import \\
+            guarded_collective
+
+
+        def _rendezvous(c):
+            return jax.lax.psum(c, "miners")
+
+
+        def shrink(c):
+            return guarded_collective(lambda: _rendezvous(c),
+                                      site="winner_select")
+        """)
+    assert _elastic(tmp_path, clean) == []
+    leaky = clean + textwrap.dedent("""\
+
+
+        def sidestep(c):
+            return _rendezvous(c)                     # SPMD004
+        """)
+    findings = _elastic(tmp_path, leaky)
+    assert [f.rule for f in findings] == ["SPMD004"]
+
+
+def test_spmd004_eager_guard_argument_is_not_guarded(tmp_path):
+    """``guarded_collective(self._rendezvous(n))`` — a forgotten lambda
+    — evaluates the rendezvous EAGERLY in the caller's thread before
+    the guard is entered: lexically inside the argument, unguarded at
+    runtime, and SPMD004 must still fire (direct collective AND the
+    one-hop idiom)."""
+    eager = textwrap.dedent("""\
+        import jax
+        from mpi_blockchain_tpu.resilience.elastic import \\
+            guarded_collective
+
+
+        def _rendezvous(c):
+            return jax.lax.psum(c, "miners")          # SPMD004 (one hop)
+
+
+        def shrink(c):
+            return guarded_collective(_rendezvous(c),
+                                      site="winner_select")
+
+
+        def direct(c):
+            return guarded_collective(
+                jax.lax.pmin(c, "miners"))            # SPMD004 (direct)
+        """)
+    findings = _elastic(tmp_path, eager)
+    assert [f.rule for f in findings] == ["SPMD004", "SPMD004"], \
+        "\n".join(f.render() for f in findings)
+    assert {"psum", "pmin"} <= {m.split("'")[1] for m in
+                                (f.message for f in findings)}
+
+
+def test_spmd004_elastic_files_exempt_from_spmd_001_003(tmp_path):
+    """Elastic files answer to SPMD004 only: guarded_collective +
+    watchdog recovery is their sanctioned alternative to the re-raise
+    discipline, so the 001-003 context rules do not double-fire there."""
+    text = textwrap.dedent("""\
+        import jax
+        from mpi_blockchain_tpu.resilience.elastic import \\
+            guarded_collective
+
+
+        def recover(c, rank):
+            try:
+                if rank == 0:
+                    return guarded_collective(
+                        lambda: jax.lax.psum(c, "miners"))
+            except Exception:
+                return None
+        """)
+    assert _elastic(tmp_path, text) == []
+
+
+def test_spmd004_live_elastic_file_clean():
+    """resilience/elastic.py itself routes every rendezvous through the
+    guard — the default-scope SPMD004 run over the real tree is clean."""
+    from mpi_blockchain_tpu.analysis.spmd_lint import run_spmd_lint
+
+    elastic = ROOT / "mpi_blockchain_tpu" / "resilience" / "elastic.py"
+    findings = [f for f in run_spmd_lint(ROOT)
+                if f.file == str(elastic.relative_to(ROOT))]
+    assert findings == []
+
+
+def test_spmd004_override_key_and_disable_file(tmp_path):
+    """elastic_files mirrors the matrix contract: CLI-reachable override
+    key + disable-file suppression."""
+    from mpi_blockchain_tpu.analysis.__main__ import OVERRIDE_KEYS
+
+    assert "elastic_files" in OVERRIDE_KEYS
+    path = tmp_path / "elastic_mod.py"
+    path.write_text(BAD_ELASTIC)
+    overrides = {"elastic_files": [path], "spmd_files": [],
+                 "mesh_py": MESH_PY}
+    findings = run_all(root=ROOT, passes=["spmd"], overrides=overrides)
+    assert "SPMD004" in {f.rule for f in findings}
+    path.write_text("# chainlint: disable-file=SPMD004\n"
+                    + path.read_text())
+    suppressed = run_all(root=ROOT, passes=["spmd"], overrides=overrides)
+    assert "SPMD004" not in {f.rule for f in suppressed}
+
+
 # ---- HOTPATH: blocking calls on the dispatch critical path -------------
 
 

@@ -1,0 +1,530 @@
+"""Elastic mesh: rank-death survival (ISSUE 9, docs/resilience.md).
+
+Covers the re-stripe partition property (every world_size <= 8 x
+dead-subset pair), the guarded-collective watchdog, the meshwatch-oracle
+eviction path, the seeded ``mesh.rank_death`` determinism, checkpointed
+membership, the in-process device-mesh shrink (chain byte-identical to
+the cpu oracle after a mid-run eviction), and the CLI/launch wiring.
+"""
+from __future__ import annotations
+
+import itertools
+import json
+import time
+
+import pytest
+
+from mpi_blockchain_tpu.config import ConfigError, MinerConfig
+from mpi_blockchain_tpu.parallel.mesh import NONCE_SPACE, stripe_windows
+from mpi_blockchain_tpu.resilience import RankLossSuspected, injection
+from mpi_blockchain_tpu.resilience.elastic import (ElasticMeshBackend,
+                                                   ElasticMiner,
+                                                   ElasticWorld,
+                                                   confirmed_dead,
+                                                   guarded_collective)
+from mpi_blockchain_tpu.resilience.faultplan import FaultPlan
+
+from conftest import needs_devices
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    yield
+    injection.disarm()
+
+
+# ---- re-striping: the partition property --------------------------------
+
+
+def _all_windows(live: list[int], batch: int, space: int):
+    return [w for j in range(len(live))
+            for w in stripe_windows(j, len(live), batch, space)]
+
+
+@pytest.mark.parametrize("space,batch", [(1 << 10, 1 << 5), (1000, 48),
+                                         (1 << 8, 1 << 8)])
+def test_restripe_partitions_space_for_every_dead_subset(space, batch):
+    """For every (world_size <= 8, dead-subset) pair the union of the
+    survivors' stripes is EXACTLY the original nonce space and the
+    stripes are pairwise disjoint — no gap, no overlap (the elastic
+    coverage invariant). Plain parametrized enumeration, no hypothesis
+    dependency."""
+    for world in range(1, 9):
+        ranks = list(range(world))
+        for k in range(world):          # dead subsets, incl. empty
+            for dead in itertools.combinations(ranks, k):
+                live = [r for r in ranks if r not in dead]
+                windows = sorted(_all_windows(live, batch, space))
+                assert windows[0][0] == 0
+                assert windows[-1][1] == space
+                # Pairwise disjoint AND gapless: sorted windows must
+                # tile the space edge to edge.
+                for (s0, e0), (s1, e1) in zip(windows, windows[1:]):
+                    assert e0 == s1, (world, dead, windows)
+                assert sum(e - s for s, e in windows) == space
+
+
+def test_stripe_windows_single_rank_is_one_window():
+    assert list(stripe_windows(0, 1, 64, 1 << 20)) == [(0, 1 << 20)]
+
+
+def test_stripe_windows_validates_inputs():
+    with pytest.raises(ConfigError):
+        list(stripe_windows(3, 3, 64))
+    with pytest.raises(ConfigError):
+        list(stripe_windows(0, 2, 0))
+
+
+# ---- guarded collectives -------------------------------------------------
+
+
+def test_guarded_collective_returns_result_and_reraises():
+    assert guarded_collective(lambda: 41 + 1, site="t", timeout_s=5) == 42
+    with pytest.raises(ZeroDivisionError):
+        guarded_collective(lambda: 1 / 0, site="t", timeout_s=5)
+
+
+def test_guarded_collective_timeout_raises_rank_loss():
+    t0 = time.monotonic()
+    with pytest.raises(RankLossSuspected) as ei:
+        guarded_collective(lambda: time.sleep(10), site="winner_select",
+                           timeout_s=0.15)
+    assert time.monotonic() - t0 < 5
+    assert ei.value.site == "winner_select"
+
+
+def test_guarded_collective_reuses_worker_but_abandons_wedged():
+    """Sequential dispatches ride the SAME pooled worker thread (no
+    thread spawn on the per-window hot path); a timed-out dispatch
+    abandons its worker, so the next dispatch gets a fresh one instead
+    of queueing behind the wedged fn."""
+    import threading
+
+    idents = [guarded_collective(
+        lambda: threading.get_ident(), site="t", timeout_s=5)
+        for _ in range(3)]
+    assert len(set(idents)) == 1
+    assert idents[0] != threading.get_ident()
+    with pytest.raises(RankLossSuspected):
+        guarded_collective(lambda: time.sleep(30), site="t",
+                           timeout_s=0.05)
+    assert guarded_collective(
+        lambda: threading.get_ident(), site="t", timeout_s=5) != idents[0]
+
+
+@pytest.mark.parametrize("kind", ["raise", "hang", "corrupt", "partial"])
+def test_guarded_collective_injected_fault_is_rank_loss(kind):
+    """Every parallel.collective fault kind surfaces as suspicion: a
+    hung, raised, or damaged rendezvous are the same event to the
+    survivor."""
+    injection.arm(FaultPlan.from_dict({"faults": [
+        {"site": "parallel.collective", "kind": kind, "call": 0,
+         "seconds": 0.01}]}))
+    with pytest.raises(RankLossSuspected):
+        guarded_collective(lambda: 1, site="t", timeout_s=5)
+    injection.disarm()
+    assert guarded_collective(lambda: 1, site="t", timeout_s=5) == 1
+
+
+# ---- the mesh.rebuild policy entry --------------------------------------
+
+
+def test_policy_mesh_rebuild_entry(monkeypatch):
+    from mpi_blockchain_tpu.resilience.policy import policy_for
+
+    assert policy_for("mesh.rebuild").max_attempts == 2
+    monkeypatch.setenv("MPIBT_MESH_REBUILD_RETRIES", "5")
+    assert policy_for("mesh.rebuild").max_attempts == 5
+    # The global cap still wins over the site knob.
+    monkeypatch.setenv("MPIBT_MAX_RETRIES", "1")
+    assert policy_for("mesh.rebuild").max_attempts == 1
+
+
+# ---- ElasticWorld: membership, oracle, determinism -----------------------
+
+
+def test_world_evict_restripes_and_reports():
+    w = ElasticWorld(4, 1)
+    assert w.index() == 1 and w.n_live == 4
+    assert w.evict(3, "test", height=5)
+    assert not w.evict(3, "test")           # idempotent
+    assert not w.evict(1, "test")           # never self
+    assert w.live == [0, 1, 2] and w.index() == 1
+    assert w.evict(0, "test", height=6)
+    assert w.index() == 0                   # dense index re-striped
+    s = w.summary()
+    assert s["shrunk"] and [e["rank"] for e in s["evicted"]] == [3, 0]
+    kinds = [r["kind"] for r in w.log.events()]
+    assert kinds.count("mesh_shrunk") == 2
+
+
+def _write_shard(directory, rank, *, age_s=0.0, final=False,
+                 exit_status=None, world=4):
+    directory.mkdir(parents=True, exist_ok=True)
+    (directory / f"rank_{rank:04d}.json").write_text(json.dumps({
+        "version": 1, "rank": rank, "world_size": world, "pid": 1,
+        "seq": 1, "final": final, "exit_status": exit_status,
+        "written_at": time.time() - age_s, "started_at": time.time() - 60,
+        "heartbeats": {"miner_heartbeat": {"value": 1, "age_s": 0.05}},
+        "registry": {}, "events_tail": [], "causal_tail": {},
+        "pipeline": []}))
+
+
+def test_oracle_evicts_dead_shard_and_failed_but_not_wedged(tmp_path):
+    obs = tmp_path / "mesh"
+    _write_shard(obs, 0)                                  # self, fresh
+    _write_shard(obs, 1, age_s=30.0)                      # dead-shard
+    _write_shard(obs, 2, final=True, exit_status=0)       # finished
+    _write_shard(obs, 3, final=True, exit_status=2)       # failed
+    dead = confirmed_dead(obs, [0, 1, 2, 3], 0, stall_s=1.0)
+    assert sorted(dead) == [(1, "dead-shard"), (3, "failed")]
+
+    w = ElasticWorld(4, 0, obs_dir=obs, stall_s=1.0)
+    w.step(height=1)
+    assert w.live == [0, 2]
+    reasons = {e["rank"]: e["reason"] for e in w.evicted}
+    assert reasons == {1: "dead-shard", 3: "failed"}
+
+
+def test_oracle_no_progress_is_restart_not_evict(tmp_path):
+    """A live-but-wedged rank (fresh shard, stale heartbeat) reads
+    recommended_action == restart — evicting a rank that later recovers
+    would re-overlap its stripes."""
+    obs = tmp_path / "mesh"
+    _write_shard(obs, 0)
+    obs_path = obs / "rank_0001.json"
+    _write_shard(obs, 1)
+    payload = json.loads(obs_path.read_text())
+    payload["heartbeats"] = {"miner_heartbeat": {"value": 1,
+                                                 "age_s": 500.0}}
+    obs_path.write_text(json.dumps(payload))
+    assert confirmed_dead(obs, [0, 1], 0, stall_s=10.0,
+                          heartbeat_stall_s=1.0) == []
+
+
+def test_oracle_missing_needs_grace(tmp_path):
+    obs = tmp_path / "mesh"
+    _write_shard(obs, 0)
+    # Rank 1 never wrote a shard: only evictable once the startup grace
+    # elapsed (allow_missing) — a late-arriving rank is not dead.
+    assert confirmed_dead(obs, [0, 1], 0, stall_s=1.0) == []
+    assert confirmed_dead(obs, [0, 1], 0, stall_s=1.0,
+                          allow_missing=True) == [(1, "missing")]
+
+
+def test_rank_death_victim_is_seeded_and_agreed_across_ranks():
+    plan = FaultPlan.from_dict({"seed": 9, "faults": [
+        {"site": "mesh.rank_death", "kind": "partial", "call": 1}]})
+    deaths: dict[int, list] = {}
+    for rank in range(4):
+        injection.arm(plan)
+        exited: list[int] = []
+        w = ElasticWorld(4, rank, hard_exit=exited.append)
+        w.step(1)     # call 0: no fault
+        w.step(2)     # call 1: fires
+        deaths[rank] = (exited, [e["rank"] for e in w.evicted])
+        injection.disarm()
+    # Every rank agrees on the victim: survivors evict it, the victim
+    # itself hard-exits.
+    victims = {ev[0] if ev else rank
+               for rank, (ex, ev) in deaths.items()}
+    assert len(victims) == 1
+    victim = next(iter(victims))
+    assert victim != 0                       # never the anchor rank
+    for rank, (exited, evicted) in deaths.items():
+        if rank == victim:
+            assert exited == [137] and evicted == []
+        else:
+            assert exited == [] and evicted == [victim]
+
+
+def test_rank_death_draw_ignores_oracle_desynced_live_sets():
+    """A wall-clock oracle eviction that landed on only SOME ranks must
+    not change the seeded victim draw: the pool is the seed world minus
+    prior rank_death victims, never the oracle-mutated live list — else
+    ranks whose polls land at different instants draw different
+    victims."""
+    plan = FaultPlan.from_dict({"seed": 9, "faults": [
+        {"site": "mesh.rank_death", "kind": "partial", "call": 0}]})
+    drawn = []
+    for oracle_evicted in (None, 1, 3):
+        injection.arm(plan)
+        w = ElasticWorld(4, 0, hard_exit=lambda rc: None)
+        if oracle_evicted is not None:
+            assert w.evict(oracle_evicted, "dead_shard", height=0)
+        w.step(1)
+        drawn.append(sorted(w._death_victims))
+        injection.disarm()
+    assert drawn[0] == drawn[1] == drawn[2]
+    assert len(drawn[0]) == 1
+
+
+def test_rank_death_consecutive_draws_kill_distinct_ranks():
+    plan = FaultPlan.from_dict({"seed": 5, "faults": [
+        {"site": "mesh.rank_death", "kind": "partial", "call": 0},
+        {"site": "mesh.rank_death", "kind": "partial", "call": 1}]})
+    injection.arm(plan)
+    try:
+        w = ElasticWorld(6, 0, hard_exit=lambda rc: None)
+        w.step(1)
+        w.step(2)
+    finally:
+        injection.disarm()
+    assert len(w._death_victims) == 2
+    assert 0 not in w._death_victims     # never the anchor rank
+
+
+def test_rank_death_explicit_victim_message():
+    injection.arm(FaultPlan.from_dict({"faults": [
+        {"site": "mesh.rank_death", "kind": "corrupt", "call": 0,
+         "message": "rank=2"}]}))
+    w = ElasticWorld(4, 0, hard_exit=lambda rc: None)
+    w.step(1)
+    assert [e["rank"] for e in w.evicted] == [2]
+
+
+# ---- checkpointed membership --------------------------------------------
+
+
+def test_membership_rides_checkpoint_sidecar(tmp_path):
+    from mpi_blockchain_tpu.models.miner import Miner
+    from mpi_blockchain_tpu.utils.checkpoint import (recover_chain,
+                                                     save_chain)
+
+    cfg = MinerConfig(difficulty_bits=8, n_blocks=2, backend="cpu")
+    miner = Miner(cfg, log_fn=lambda d: None)
+    miner.mine_chain()
+    w = ElasticWorld(4, 0)
+    w.evict(2, "rank_death", height=1)
+    path = tmp_path / "ck.bin"
+    save_chain(miner.node, path, cfg, mesh=w.membership())
+
+    node, report = recover_chain(path, 8)
+    assert node.height == 2
+    assert report["mesh"] == {"world_size": 4, "live": [0, 1, 3],
+                              "evicted": [{"rank": 2,
+                                           "reason": "rank_death",
+                                           "height": 1}]}
+    restored = ElasticWorld(4, 0)
+    restored.restore(report["mesh"])
+    assert restored.live == [0, 1, 3] and restored.evicted == w.evicted
+
+    # A dead rank must not resume into stripes the survivors re-covered.
+    zombie = ElasticWorld(4, 2)
+    with pytest.raises(ConfigError):
+        zombie.restore(report["mesh"])
+
+
+def test_membership_survives_torn_tail_recovery(tmp_path):
+    from mpi_blockchain_tpu.models.miner import Miner
+    from mpi_blockchain_tpu.utils.checkpoint import (recover_chain,
+                                                     save_chain)
+
+    cfg = MinerConfig(difficulty_bits=8, n_blocks=3, backend="cpu")
+    miner = Miner(cfg, log_fn=lambda d: None)
+    miner.mine_chain()
+    w = ElasticWorld(2, 0)
+    w.evict(1, "dead-shard", height=2)
+    path = tmp_path / "ck.bin"
+    save_chain(miner.node, path, cfg, mesh=w.membership())
+    blob = path.read_bytes()
+    path.write_bytes(blob[:-120])           # torn tail
+    node, report = recover_chain(path, 8)
+    assert report["recovered"] and node.height == 2
+    assert report["mesh"]["live"] == [0]    # preserved through rewrite
+
+
+# ---- the striped elastic miner ------------------------------------------
+
+
+def test_elastic_miner_sweeps_only_its_stripes_and_mines_valid_chain():
+    from mpi_blockchain_tpu import core
+
+    w = ElasticWorld(3, 1)
+    cfg = MinerConfig(difficulty_bits=10, n_blocks=3, backend="cpu",
+                      batch_pow2=12)
+    miner = ElasticMiner(cfg, w, log_fn=lambda d: None)
+    recs = miner.mine_chain()
+    for rec in recs:
+        assert any(s <= rec.nonce < e
+                   for s, e in w.stripe_windows(cfg.batch_size)), rec
+    # Mid-run eviction re-stripes; mining continues and stays valid.
+    w.evict(0, "test", height=3)
+    assert w.index() == 0
+    miner.mine_chain(2)
+    assert core.Node(10, 0).load(miner.node.save())
+    mine_events = [r for r in w.log.events() if r["kind"] == "mine"]
+    assert [r["height"] for r in mine_events] == [1, 2, 3, 4, 5]
+
+
+def test_default_miner_single_window_unchanged():
+    from mpi_blockchain_tpu.models.miner import Miner
+
+    assert tuple(Miner(MinerConfig(difficulty_bits=8, backend="cpu"),
+                       log_fn=lambda d: None).search_windows()) == \
+        ((0, NONCE_SPACE),)
+
+
+# ---- the in-process device-mesh flavor ----------------------------------
+
+
+@needs_devices(4)
+def test_mesh_backend_shrinks_and_chain_stays_byte_identical():
+    """An injected collective fault mid-run shrinks the mesh 4 -> 3;
+    the lowest-nonce rule makes the mined chain byte-identical to the
+    cpu oracle anyway — the elastic rebuild is invisible to the
+    determinism contract."""
+    from mpi_blockchain_tpu.models.miner import Miner
+
+    cfg = MinerConfig(difficulty_bits=10, n_blocks=4, backend="tpu",
+                      kernel="jnp", n_miners=4, batch_pow2=10)
+    injection.arm(FaultPlan.from_dict({"faults": [
+        {"site": "parallel.collective", "kind": "raise", "call": 2,
+         "times": 1}]}))
+    backend = ElasticMeshBackend(cfg)
+    miner = Miner(cfg, backend=backend, log_fn=lambda d: None)
+    miner.mine_chain()
+    injection.disarm()
+    assert backend.n_live == 3 and backend.summary()["shrunk"]
+    # The device count lives in its OWN gauge: mesh_live_ranks counts
+    # rank processes and must not be overwritten by the device flavor.
+    from mpi_blockchain_tpu import telemetry
+    assert telemetry.gauge("mesh_live_devices").value == 3
+    oracle = Miner(MinerConfig(difficulty_bits=10, n_blocks=4,
+                               backend="cpu"), log_fn=lambda d: None)
+    oracle.mine_chain()
+    assert miner.node.save() == oracle.node.save()
+
+
+@needs_devices(2)
+def test_mesh_backend_exhausted_shrink_reraises():
+    cfg = MinerConfig(difficulty_bits=8, n_blocks=1, backend="tpu",
+                      kernel="jnp", n_miners=2, batch_pow2=8)
+    backend = ElasticMeshBackend(cfg)
+    # Call 0 (search @2 devices) and call 2 (search @1 device) die; the
+    # rebuild between them (call 1) succeeds. The ladder floors at one
+    # device, then the suspicion re-raises instead of looping forever.
+    injection.arm(FaultPlan.from_dict({"faults": [
+        {"site": "parallel.collective", "kind": "raise", "call": 0,
+         "times": 1},
+        {"site": "parallel.collective", "kind": "raise", "call": 2,
+         "times": 1}]}))
+    with pytest.raises(RankLossSuspected):
+        backend.search(bytes(80), 8)
+    assert backend.n_live == 1
+
+
+@needs_devices(2)
+def test_mesh_backend_wedged_rebuild_is_retry_exhausted():
+    """When the REBUILD itself keeps dying, the mesh.rebuild budget
+    surfaces as RetryExhausted (CLI rc 2) — a fabric that keeps wedging
+    is a dead run, not an infinite shrink loop."""
+    from mpi_blockchain_tpu.resilience import RetryExhausted
+
+    cfg = MinerConfig(difficulty_bits=8, n_blocks=1, backend="tpu",
+                      kernel="jnp", n_miners=2, batch_pow2=8)
+    backend = ElasticMeshBackend(cfg)
+    injection.arm(FaultPlan.from_dict({"faults": [
+        {"site": "parallel.collective", "kind": "raise", "call": 0,
+         "times": -1}]}))
+    with pytest.raises(RetryExhausted) as ei:
+        backend.search(bytes(80), 8)
+    assert isinstance(ei.value.last, RankLossSuspected)
+
+
+def test_mesh_backend_rejects_single_device_config():
+    with pytest.raises(ConfigError):
+        ElasticMeshBackend(MinerConfig(backend="tpu", n_miners=1))
+    with pytest.raises(ConfigError):
+        ElasticMeshBackend(MinerConfig(backend="cpu", n_miners=4))
+
+
+# ---- CLI + launch wiring -------------------------------------------------
+
+
+def _run_cli(argv):
+    import contextlib
+    import io
+
+    from mpi_blockchain_tpu.cli import main
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = main(argv)
+    lines = [ln for ln in buf.getvalue().splitlines() if ln.strip()]
+    return rc, (json.loads(lines[-1]) if lines else {})
+
+
+def test_cli_elastic_mine_summary_and_events_dump(tmp_path):
+    dump = tmp_path / "causal.json"
+    rc, out = _run_cli(["mine", "--difficulty", "8", "--blocks", "2",
+                        "--backend", "cpu", "--elastic",
+                        "--batch-pow2", "12",
+                        "--process-id", "1", "--num-processes", "3",
+                        "--events-dump", str(dump)])
+    assert rc == 0 and out["height"] == 2
+    assert out["mesh"]["live"] == [0, 1, 2]
+    assert out["mesh"]["rank"] == 1 and not out["mesh"]["shrunk"]
+    payload = json.loads(dump.read_text())
+    assert [r["kind"] for r in payload["nodes"]["1"]] == ["mine", "mine"]
+
+
+def test_cli_elastic_refuses_coordinator_and_fused():
+    rc, out = _run_cli(["mine", "--elastic", "--coordinator",
+                        "127.0.0.1:1", "--difficulty", "8"])
+    assert rc == 2 and "jax.distributed" in out["error"]
+    rc, out = _run_cli(["mine", "--elastic", "--fused",
+                        "--difficulty", "8"])
+    assert rc == 2 and "fused" in out["error"]
+
+
+def test_cli_elastic_resume_restores_shrunken_world(tmp_path):
+    """--resume must restore the SHRUNKEN world from the sidecar: the
+    resumed rank keeps its re-striped share instead of re-assuming the
+    seed world."""
+    from mpi_blockchain_tpu.models.miner import Miner
+    from mpi_blockchain_tpu.utils.checkpoint import save_chain
+
+    cfg = MinerConfig(difficulty_bits=8, n_blocks=2, backend="cpu")
+    seed_miner = Miner(cfg, log_fn=lambda d: None)
+    seed_miner.mine_chain()
+    w = ElasticWorld(3, 0)
+    w.evict(2, "dead-shard", height=2)
+    ck = tmp_path / "ck.bin"
+    save_chain(seed_miner.node, ck, cfg, mesh=w.membership())
+    rc, out = _run_cli(["mine", "--difficulty", "8", "--blocks", "4",
+                        "--backend", "cpu", "--elastic",
+                        "--process-id", "0", "--num-processes", "3",
+                        "--resume", str(ck)])
+    assert rc == 0 and out["height"] == 4
+    assert out["mesh"]["live"] == [0, 1]
+    assert [e["rank"] for e in out["mesh"]["evicted"]] == [2]
+
+
+@needs_devices(8)
+def test_v5e8_launch_elastic_tip_invariant_under_shrink():
+    """The elastic launch path: an injected collective fault shrinks the
+    8-device mesh mid-run, and the pre-registered small-scale tip still
+    matches — n_miners-invariance doing resilience work."""
+    import pathlib
+    import sys
+
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent
+                           / "experiments"))
+    import v5e8_launch
+
+    overrides = {"difficulty_bits": 10, "n_blocks": 4, "kernel": "jnp",
+                 "batch_pow2": 10}
+    baseline = v5e8_launch.launch(preset_overrides=overrides,
+                                  blocks_per_call=2, expected_tip=None)
+    injection.arm(FaultPlan.from_dict({"faults": [
+        {"site": "parallel.collective", "kind": "raise", "call": 3,
+         "times": 1}]}))
+    report = v5e8_launch.launch(preset_overrides=overrides,
+                                blocks_per_call=2,
+                                expected_tip=baseline["tip_hash"],
+                                elastic=True)
+    injection.disarm()
+    assert report["elastic"] and report["tip_matches_preregistered"]
+    assert report["elastic_mesh"]["shrunk"]
+    assert report["elastic_mesh"]["n_live"] == 7

@@ -343,6 +343,41 @@ def test_mesh_health_empty_directory(tmp_path):
     assert code == 503 and health["status"] == "no-shards"
 
 
+def test_recommended_action_is_the_one_shared_verdict(tmp_path):
+    """Every per-rank payload carries the machine-readable recovery
+    verdict the elastic supervisor and /healthz readers share: alive or
+    cleanly done -> none, wedged-but-alive -> restart (evicting a rank
+    that later recovers would re-overlap its stripes), provably gone
+    (dead-shard, failed, missing) -> evict."""
+    from mpi_blockchain_tpu.meshwatch import recommended_action
+
+    assert recommended_action("ok") == "none"
+    assert recommended_action("finished") == "none"
+    assert recommended_action("stale", "no-progress") == "restart"
+    assert recommended_action("stale", "dead-shard") == "evict"
+    assert recommended_action("failed") == "evict"
+    assert recommended_action("missing") == "evict"
+
+    shards = [_shard(0, final=False, world=5),              # ok
+              _shard(1, final=True),                        # finished
+              _shard(2, final=False, age_s=100),            # dead-shard
+              dict(_shard(3, final=True), exit_status=2)]   # failed
+    code, health = mesh_health(tmp_path, stall_s=5.0, shards=shards)
+    actions = {r: info["recommended_action"]
+               for r, info in health["ranks"].items()}
+    assert actions == {"0": "none", "1": "none", "2": "evict",
+                       "3": "evict", "4": "evict"}   # 4 is missing
+    assert health["ranks"]["2"]["stale_reason"] == "dead-shard"
+
+    wedged = _shard(1, final=False, age_s=0.0,
+                    heartbeats={"miner_heartbeat": {"value": 4,
+                                                    "age_s": 120.0}})
+    _, health = mesh_health(tmp_path, stall_s=5.0,
+                            heartbeat_stall_s=30.0,
+                            shards=[_shard(0, final=False), wedged])
+    assert health["ranks"]["1"]["recommended_action"] == "restart"
+
+
 def test_render_mesh_prometheus_sum_and_rank_labels():
     shards = [
         _shard(0, counters={"hashes_tried_total": ({"backend": "cpu"}, 10)},
