@@ -6,10 +6,10 @@ SHELL := /bin/bash   # tier1 uses pipefail/PIPESTATUS
 
 .PHONY: check lint lint-fast metrics-smoke forensics-smoke perf-smoke \
         chaos-smoke adversary-smoke meshwatch-smoke elastic-smoke \
-        tier1 core clean
+        trace-smoke tier1 core clean
 
 check: lint metrics-smoke forensics-smoke perf-smoke chaos-smoke \
-        adversary-smoke meshwatch-smoke elastic-smoke tier1
+        adversary-smoke meshwatch-smoke elastic-smoke trace-smoke tier1
 
 # chainlint: binding contract, header layout, JAX purity, sanitizer
 # matrix, thread races (CONC), SPMD collectives, hot-path blocking,
@@ -132,6 +132,17 @@ elastic-smoke:
 	env JAX_PLATFORMS=cpu $(PY) -m mpi_blockchain_tpu.resilience \
 	    elastic-smoke 2>/dev/null || { echo "elastic-smoke: failed"; exit 1; }; \
 	echo "elastic-smoke: ok"
+
+# Trace smoke: the ISSUE 10 gate — a 2-rank --mesh-obs run with tracing
+# on must yield a COMPLETE critical path (gap_pct < 5) for every mined
+# height on every rank, a deterministic report, a loadable Perfetto
+# export carrying the critical-path flow, and a telemetry self-overhead
+# measurement inside the < 3% observer-effect budget, gated through the
+# perfwatch detector's trace_overhead absolute bound.
+trace-smoke:
+	env JAX_PLATFORMS=cpu $(PY) -m mpi_blockchain_tpu.blocktrace smoke \
+	    2>/dev/null || { echo "trace-smoke: failed"; exit 1; }; \
+	echo "trace-smoke: ok"
 
 # Perfwatch smoke: serve a faulted instrumented run, scrape /metrics +
 # /healthz live, then prove the regression sentinel flags an injected

@@ -34,7 +34,8 @@ OVERRIDE_KEYS = ("capi", "ctypes_binding", "pybind", "chain_hpp",
                  "chain_cpp", "core_init", "sha_jnp", "header_test",
                  "mesh_py", "core_makefile", "core_src", "sim_py",
                  "telemetry_files", "resilience_files",
-                 "adversary_files", "rank_scope_files", "jax_files",
+                 "adversary_files", "rank_scope_files",
+                 "blocktrace_scope_files", "jax_files",
                  "conc_files", "spmd_files", "elastic_files",
                  "hotpath_files", "opbudget_json", "kernel_src")
 

@@ -70,6 +70,10 @@ SANCTIONED_SEAMS = (
     "mpi_blockchain_tpu/telemetry",
     "mpi_blockchain_tpu/meshwatch",
     "mpi_blockchain_tpu/perfwatch",
+    # blocktrace: in-memory trace context + per-block waterfall math —
+    # the same sanctioned hot-loop sink as telemetry (no file I/O on
+    # any path reachable from the miner).
+    "mpi_blockchain_tpu/blocktrace",
     "mpi_blockchain_tpu/resilience/policy.py",
     "mpi_blockchain_tpu/resilience/injection.py",
     "mpi_blockchain_tpu/utils/logging.py",

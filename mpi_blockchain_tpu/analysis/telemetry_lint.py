@@ -32,13 +32,27 @@ a future edit that emits a bus event through the raw JSON-lines stream
           mesh rank) — or an 8-rank merge silently splits one series
           into differently-spelled ones.
 
+  TEL004  a per-dispatch emit point (``profiler().dispatch(...)``) in
+          the mining hot loop that does not thread the block trace
+          context: the call must carry a ``height=`` keyword (or a
+          ``**meta`` spread whose contents the lint cannot see). The
+          blocktrace critical-path join attributes segments to blocks
+          through the record's meta height (or per-segment trace
+          stamps); a dispatch born without one produces segments the
+          per-block waterfall can only count as ``unattributed`` — the
+          drift bug that silently hollows out ``perfwatch
+          critical-path`` (docs/observability.md §blocktrace).
+
 Scope: TEL001 over ``mpi_blockchain_tpu/simulation.py`` (the bus
 surface; override key ``sim_py``); TEL002 over every ``.py`` in the
 package (override key ``telemetry_files`` — the drift-fixture seam);
 TEL003 over the multi-rank surfaces — ``parallel/``, ``meshwatch/``,
 ``bench_lib.py``, and the multiprocess experiments
 (``experiments/multiprocess_world.py``, ``experiments/v5e8_launch.py``;
-override key ``rank_scope_files``).
+override key ``rank_scope_files``); TEL004 over the miner/fused/elastic
+mining loop plus the CLI seam — ``models/miner.py``, ``models/fused.py``,
+``resilience/elastic.py``, ``cli.py`` (override key
+``blocktrace_scope_files``).
 """
 from __future__ import annotations
 
@@ -164,6 +178,64 @@ def _run_naming_lint(root: pathlib.Path, files) -> list[Finding]:
     return findings
 
 
+def _blocktrace_scope_files(root: pathlib.Path) -> list[pathlib.Path]:
+    """TEL004's surface: everywhere a mining dispatch record is born
+    (missing files are skipped, matching the other scope builders)."""
+    pkg = root / "mpi_blockchain_tpu"
+    return sorted(p for p in (pkg / "models" / "miner.py",
+                              pkg / "models" / "fused.py",
+                              pkg / "resilience" / "elastic.py",
+                              pkg / "cli.py") if p.is_file())
+
+
+def _is_profiler_dispatch(node: ast.Call) -> bool:
+    """``profiler().dispatch(...)`` / ``profiler(...).dispatch(...)`` —
+    the emit-point idiom, including aliased imports (``from ... import
+    profiler as _profiler`` in cli.py), hence the suffix match; the
+    profiler's own internal ``self.dispatch`` fallback
+    (``segment_on_last``) deliberately does not match."""
+    func = node.func
+    if not (isinstance(func, ast.Attribute) and func.attr == "dispatch"
+            and isinstance(func.value, ast.Call)):
+        return False
+    name = _call_name(func.value)
+    return bool(name) and name.endswith("profiler")
+
+
+def _run_blocktrace_lint(root: pathlib.Path, files) -> list[Finding]:
+    """TEL004: every mining-loop dispatch emit point threads the block
+    trace context via an explicit ``height=`` (a ``**`` spread is
+    opaque and passes — the call site owns it)."""
+    findings: list[Finding] = []
+    for path in files:
+        rel = rel_path(path, root)
+        try:
+            tree = ast.parse(path.read_text(), filename=str(path))
+        except SyntaxError as e:
+            findings.append(Finding(rel, e.lineno or 1, "TEL000",
+                                    f"syntax error: {e.msg}"))
+            continue
+        except OSError:
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call) or \
+                    not _is_profiler_dispatch(node):
+                continue
+            has_height = any(kw.arg in ("height", None)
+                             for kw in node.keywords)
+            if not has_height:
+                findings.append(Finding(
+                    rel, node.lineno, "TEL004",
+                    "profiler().dispatch() without height= — the "
+                    "dispatch record carries no block identity, so its "
+                    "segments fall out of the per-block critical-path "
+                    "join as `unattributed`; thread the block trace "
+                    "context (pass height=..., or run inside "
+                    "blocktrace.trace_block which defaults it) — "
+                    "docs/observability.md §blocktrace"))
+    return findings
+
+
 def _run_rank_label_lint(root: pathlib.Path, files) -> list[Finding]:
     """TEL003: no hand-rolled ``rank=`` label on a raw registry call in
     multi-rank code."""
@@ -204,6 +276,9 @@ def run_telemetry_lint(root: pathlib.Path, overrides=None,
     rank_files = override_files(overrides, "rank_scope_files",
                                 lambda: _rank_scope_files(root))
     findings.extend(_run_rank_label_lint(root, rank_files))
+    bt_files = override_files(overrides, "blocktrace_scope_files",
+                              lambda: _blocktrace_scope_files(root))
+    findings.extend(_run_blocktrace_lint(root, bt_files))
     sim_py = overrides.get(
         "sim_py", root / "mpi_blockchain_tpu" / "simulation.py")
     rel = rel_path(sim_py, root)

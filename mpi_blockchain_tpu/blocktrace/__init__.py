@@ -1,0 +1,50 @@
+"""blocktrace — per-block critical-path attribution across ranks.
+
+Every existing lens is an *aggregate*: span summaries say how much time a
+layer ate overall, the pipeline report prices overlap and bubble across a
+whole run, causal logs order events without wall time, perfwatch history
+tracks headline rates. None of them can answer "where did block N's wall
+time go, and what was on its critical path?" — the per-unit question the
+async-pipelined-dispatch refactor (ROADMAP item 4) and the op-cut work
+(item 2) are judged on.
+
+This package closes that gap with three pieces:
+
+* **context** (this module re-exports it) — a thread-local *block trace
+  context*: a ``(height, template, rank)`` identity pushed by
+  ``trace_block(height, template=...)`` around everything a block
+  traverses. The telemetry layer consults it implicitly: pipeline
+  profiler segments recorded inside the context carry a ``height`` (so
+  a fused batch's per-block validate/append segments are individually
+  attributable), and ``emit_event`` stamps a ``trace`` field onto every
+  event emitted in scope (retry, degradation, collective-timeout,
+  checkpoint events all join the block that suffered them).
+
+* **critical_path** — the mesh-wide analyzer: joins pipeline records
+  (in-process or from ``--mesh-obs`` shards) into a per-block waterfall
+  — per-stage *exclusive* wall time, the single longest dependency
+  chain, a device / collective-wait / host split, and gap accounting
+  such that ``sum(stages) + gap == wall`` exactly (no double-count:
+  every instant of the block's wall is attributed to at most one
+  stage). Deterministic: a pure function of its record set.
+
+* **overhead** — the telemetry self-audit: always-on tracing must stay
+  honest, so ``measure_trace_overhead`` prices the instrumentation
+  itself (instrumented vs ``MPIBT_TELEMETRY_OFF`` sweep throughput
+  delta) as the ``trace_overhead`` bench section, recorded to
+  PERF_HISTORY.jsonl and gated (< 3%) by ``perfwatch check``.
+
+Surfaces: ``python -m mpi_blockchain_tpu.perfwatch critical-path``
+(text / ``--json`` / ``--trace`` Perfetto export with the critical path
+as a highlighted flow) and ``python -m mpi_blockchain_tpu.blocktrace
+smoke`` (the ``make trace-smoke`` gate).
+
+Import discipline: this ``__init__`` re-exports ONLY the context layer —
+``meshwatch.pipeline`` imports it from inside the telemetry hot path, so
+pulling the analyzer (which imports meshwatch back) here would cycle.
+Analyzer/overhead callers import their submodules explicitly.
+"""
+from __future__ import annotations
+
+from .context import (BlockTrace, current_trace, trace_block,  # noqa: F401
+                      trace_dict)

@@ -1,0 +1,96 @@
+"""The block trace context: a (height, template, rank) identity stamped
+through every subsystem a block traverses.
+
+``trace_block(height, template=...)`` pushes one frame on a thread-local
+stack (each thread traces its own block, mirroring the span stack's
+discipline — the GIL-free bench pool cannot corrupt nesting). The
+innermost frame is what the telemetry layer consults:
+
+* ``meshwatch.pipeline.DispatchRecord.add_segment`` stamps the frame's
+  ``height``/``template`` onto every segment recorded in scope;
+* ``telemetry.events.emit_event`` attaches a ``trace`` dict to every
+  event emitted in scope (unless the record already carries one);
+* ``PipelineProfiler.dispatch`` defaults its meta's ``height`` from the
+  frame when the call site did not pass one.
+
+``template`` is the per-height template rebuild counter — the
+extra-nonce rollover index for the per-block miner, the rollover index
+of the fused recovery path. ``rank`` defaults to the process's declared
+mesh rank so cross-rank joins need no extra bookkeeping.
+
+Pure stdlib, in-memory only: safe on the chainlint HOTPATH.
+"""
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import threading
+
+_tls = threading.local()
+
+
+@dataclasses.dataclass(frozen=True)
+class BlockTrace:
+    """One block's trace identity."""
+    height: int
+    template: int = 0
+    rank: int = 0
+
+    def to_dict(self) -> dict:
+        return {"height": self.height, "template": self.template,
+                "rank": self.rank}
+
+
+def _stack() -> list:
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = _tls.stack = []
+    return stack
+
+
+def current_trace() -> BlockTrace | None:
+    """The innermost open block trace on this thread, or None."""
+    stack = _stack()
+    return stack[-1] if stack else None
+
+
+def trace_dict() -> dict | None:
+    """The innermost trace as a JSON-able dict, or None when no block
+    is in scope — the stamp ``emit_event`` attaches."""
+    t = current_trace()
+    return None if t is None else t.to_dict()
+
+
+@contextlib.contextmanager
+def trace_block(height: int, template: int | None = None,
+                rank: int | None = None):
+    """Declares everything inside as work on block ``height``.
+
+    ``template`` defaults to the enclosing frame's template when
+    re-entering the same height (the miner pushes an outer
+    height-scoped frame, then per-extra-nonce frames inside), else 0;
+    ``rank`` defaults to the process's declared mesh rank.
+
+    With ``MPIBT_TELEMETRY_OFF`` this is a bare yield (no stack, no
+    frame): the context is itself instrumentation, so the overhead
+    audit's off leg must not pay for it.
+    """
+    from ..telemetry import mesh_rank
+    from ..telemetry.registry import telemetry_disabled
+
+    if telemetry_disabled():
+        yield None
+        return
+    stack = _stack()
+    if template is None:
+        parent = stack[-1] if stack else None
+        template = (parent.template
+                    if parent is not None and parent.height == height
+                    else 0)
+    frame = BlockTrace(height=int(height), template=int(template),
+                       rank=int(rank if rank is not None else mesh_rank()))
+    stack.append(frame)
+    try:
+        yield frame
+    finally:
+        stack.pop()

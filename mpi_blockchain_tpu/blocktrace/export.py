@@ -1,0 +1,60 @@
+"""Perfetto export of a critical-path report: the pipeline's wall-clock
+rank/stage rows (reused verbatim from ``meshwatch.pipeline``) plus one
+dedicated **critical path** process row whose slices are each block's
+critical-path runs, chained by flow events — the highlighted arrow trail
+is the block's longest dependency chain on ui.perfetto.dev.
+
+Flow events pair by (cat, id); one flow per block (id = the height) with
+a start (``ph: s``) on the first run, steps (``ph: t``) on each middle
+run, and a finish (``ph: f``, ``bp: e``) on the last — each bound to its
+run's slice by landing inside it.
+"""
+from __future__ import annotations
+
+from ..meshwatch.pipeline import to_chrome_trace
+
+#: The critical-path row's pid — far above any real rank.
+CRITICAL_PID = 999999
+
+
+def to_critical_path_trace(report: dict, records: list[dict]) -> dict:
+    """Chrome trace-event JSON: base pipeline rows + the critical-path
+    row. Deterministic for a deterministic (report, records) pair."""
+    trace = to_chrome_trace(records)
+    events = trace["traceEvents"]
+    epoch = trace.get("metadata", {}).get("epoch_unix_s")
+    if epoch is None:       # no segments at all: nothing to highlight
+        return trace
+    events.append({"ph": "M", "name": "process_name", "pid": CRITICAL_PID,
+                   "tid": 0, "args": {"name": "critical path"}})
+    for h in report["heights"]:
+        block = report["blocks"][str(h)]
+        ranks = block["ranks"]
+        straggler = str(block["critical_rank"])
+        base_us = (ranks[straggler]["t0"] - epoch) * 1e6
+        events.append({"ph": "M", "name": "thread_name",
+                       "pid": CRITICAL_PID, "tid": int(h),
+                       "args": {"name": f"block {h}"}})
+        runs = block["critical_path"]
+        for i, run in enumerate(runs):
+            ts = round(base_us + run["start_ms"] * 1e3, 3)
+            dur = round(max(run["ms"], 1e-4) * 1e3, 3)
+            events.append({
+                "ph": "X", "cat": "critical_path",
+                "name": f"critical:{run['stage']}",
+                "pid": CRITICAL_PID, "tid": int(h), "ts": ts, "dur": dur,
+                "args": {"height": int(h), "rank": run["rank"],
+                         "ms": run["ms"]},
+            })
+            if len(runs) < 2:    # nothing to chain: no dangling flow
+                continue
+            flow = {"cat": "critical_path", "name": f"block {h}",
+                    "id": int(h), "pid": CRITICAL_PID, "tid": int(h)}
+            mid_ts = round(ts + dur / 2, 3)
+            if i == 0:
+                events.append({**flow, "ph": "s", "ts": mid_ts})
+            elif i == len(runs) - 1:
+                events.append({**flow, "ph": "f", "bp": "e", "ts": mid_ts})
+            else:
+                events.append({**flow, "ph": "t", "ts": mid_ts})
+    return trace

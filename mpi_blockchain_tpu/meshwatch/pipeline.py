@@ -40,12 +40,23 @@ from __future__ import annotations
 import threading
 import time
 
+from ..blocktrace.context import current_trace
 from ..telemetry import mesh_rank
+from ..telemetry.registry import telemetry_disabled
 
 #: Canonical stage names, in pipeline order. ``device`` is the in-flight
-#: window; everything else is host work.
-STAGES = ("enqueue", "device", "validate", "append", "checkpoint")
-HOST_STAGES = tuple(s for s in STAGES if s != "device")
+#: window; ``collective`` is a guarded rendezvous wait
+#: (resilience/elastic.guarded_collective) — blocked-on-the-fabric time,
+#: distinct from device compute; everything else is host work.
+STAGES = ("enqueue", "device", "collective", "validate", "append",
+          "checkpoint")
+#: Stages that are NOT host work — device compute, and the collective
+#: fabric wait (blocked-on-the-fabric is neither compute nor work) —
+#: so the overlap report must not price them as host busy time (a
+#: rendezvous spanning a device window would otherwise read as perfect
+#: host/device pipelining). Every other stage, known or custom, counts
+#: as host work.
+NON_HOST_STAGES = ("device", "collective")
 
 RING_SIZE = 4096
 
@@ -61,29 +72,89 @@ class DispatchRecord:
                        "meta": meta, "segments": []}
 
     def add_segment(self, stage: str, t0: float, t1: float) -> None:
-        self.record["segments"].append(
-            {"stage": str(stage), "t0": float(t0), "t1": float(t1)})
+        seg = {"stage": str(stage), "t0": float(t0), "t1": float(t1)}
+        # A segment recorded inside a blocktrace scope carries its exact
+        # block identity — how a fused batch's per-block validate/append
+        # segments stay individually attributable (blocktrace/
+        # critical_path.py attribution rule 1).
+        trace = current_trace()
+        if trace is not None:
+            seg["height"] = trace.height
+            if trace.template:
+                seg["template"] = trace.template
+        self.record["segments"].append(seg)
 
-    def segment(self, stage: str):
-        """``with rec.segment("append"): ...`` times one segment."""
-        return _SegmentCtx(self, stage)
+    def segment(self, stage: str, chained: bool = True):
+        """``with rec.segment("append"): ...`` times one segment.
+
+        Chained: the segment opens at this record's previous segment's
+        end (when that end is in the past), not at entry time — the
+        few-microsecond host orchestration between stages belongs to
+        the dispatch, and charging it to the *following* stage keeps
+        the per-block gap accounting (blocktrace) structurally zero
+        inside a dispatch instead of polluted by instrumentation seams.
+        ``chained=False`` opts out for segments that are NOT the next
+        stage of a sequential pipeline (a collective wait concurrent
+        with other work must start at its true entry time, not be
+        backdated to the previous stage boundary).
+        """
+        return _SegmentCtx(self, stage, chained=chained)
 
     def now(self) -> float:
         return self._profiler.now()
 
 
 class _SegmentCtx:
-    def __init__(self, rec: DispatchRecord, stage: str):
+    def __init__(self, rec: DispatchRecord, stage: str,
+                 chained: bool = True):
         self._rec, self._stage = rec, stage
+        self._chained = chained
         self._t0 = 0.0
 
     def __enter__(self):
-        self._t0 = self._rec.now()
+        now = self._rec.now()
+        if not self._chained:
+            self._t0 = now
+            return self
+        segs = self._rec.record["segments"]
+        last_end = max((s["t1"] for s in segs), default=None)
+        self._t0 = (last_end if last_end is not None and last_end <= now
+                    else now)
         return self
 
     def __exit__(self, *exc):
         self._rec.add_segment(self._stage, self._t0, self._rec.now())
         return False
+
+
+class _NullDispatchRecord:
+    """The do-nothing record ``dispatch()`` hands out while telemetry is
+    off (MPIBT_TELEMETRY_OFF): segments vanish, ``now()`` stays real —
+    callers use it for their own arithmetic (the fused drain's latency
+    math), not just for segments."""
+
+    record = {"dispatch": -1, "rank": 0, "meta": {}, "segments": []}
+
+    def add_segment(self, stage: str, t0: float, t1: float) -> None:
+        pass
+
+    def segment(self, stage: str, chained: bool = True):
+        return _NULL_SEGMENT_CTX
+
+    def now(self) -> float:
+        return time.time()
+
+
+class _NullSegmentCtx:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_RECORD = _NullDispatchRecord()
+_NULL_SEGMENT_CTX = _NullSegmentCtx()
 
 
 class PipelineProfiler:
@@ -103,26 +174,36 @@ class PipelineProfiler:
         return self._anchor + time.perf_counter()
 
     def dispatch(self, **meta) -> DispatchRecord:
-        """Open a new dispatch record (ring-bounded)."""
+        """Open a new dispatch record (ring-bounded). Inside a
+        ``blocktrace.trace_block`` scope the meta's ``height`` defaults
+        from the trace context when the call site passed none."""
+        if telemetry_disabled():
+            return _NULL_RECORD
+        meta = dict(meta)
+        trace = current_trace()
+        if trace is not None and meta.get("height") is None:
+            meta["height"] = trace.height
         with self._lock:
             rec = DispatchRecord(self, self._next_id, mesh_rank(),
-                                 dict(meta))
+                                 meta)
             self._next_id += 1
             self._records.append(rec)
             if len(self._records) > self._capacity:
                 del self._records[:len(self._records) - self._capacity]
             return rec
 
-    def segment_on_last(self, stage: str):
+    def segment_on_last(self, stage: str, chained: bool = True):
         """Context manager timing a segment onto the newest record —
         the seam for work that happens outside the miner (the CLI's
         periodic checkpoint save). Opens a fresh record when none
-        exists yet."""
+        exists yet. ``chained`` as in ``DispatchRecord.segment``."""
+        if telemetry_disabled():
+            return _NULL_SEGMENT_CTX
         with self._lock:
             rec = self._records[-1] if self._records else None
         if rec is None:
             rec = self.dispatch(kind=stage)
-        return rec.segment(stage)
+        return rec.segment(stage, chained=chained)
 
     def records(self, tail: int | None = None) -> list[dict]:
         """Copies of the ringed records; ``tail`` bounds the copy to the
@@ -232,8 +313,11 @@ def pipeline_report(records: list[dict] | None = None,
             stage_totals[s["stage"]] += s["t1"] - s["t0"]
         device_u = _union([(s["t0"], s["t1"]) for s in segs
                            if s["stage"] == "device"])
+        # Host busy = host WORK only: collective segments are fabric
+        # waits (see NON_HOST_STAGES) — they must neither inflate
+        # host_busy nor count as host/device overlap.
         host_u = _union([(s["t0"], s["t1"]) for s in segs
-                         if s["stage"] != "device"])
+                         if s["stage"] not in NON_HOST_STAGES])
         device_busy = _length(device_u)
         host_busy = _length(host_u)
         overlap = _intersect(device_u, host_u)

@@ -30,9 +30,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import core
+from ..blocktrace import trace_block
+from ..blocktrace.critical_path import observe_batch_metrics
 from ..config import MAX_EXTRA_NONCE, ConfigError, extend_payload
 from ..meshwatch.pipeline import profiler
-from ..telemetry import counter, heartbeat
+from ..telemetry import counter, heartbeat, histogram
 from ..telemetry.spans import span
 from ..ops.sha256_jnp import (IV, _bswap32, compress,
                               sha256d_words_from_midstate)
@@ -189,10 +191,26 @@ class FusedMiner:
         """
         n = n_blocks if n_blocks is not None else self.config.n_blocks
         while n > 0:
+            start = self.node.height
             mined = self._mine_span(n)
             n -= mined
             if on_progress is not None and mined:
-                on_progress(self.node.height)
+                # In-scope of the newest block's trace: the span-boundary
+                # checkpoint's pipeline segment joins the block it paid
+                # for (same seam as Miner.mine_chain's on_block).
+                with trace_block(self.node.height):
+                    on_progress(self.node.height)
+            if mined:
+                # Live block_critical_path_ms{stage} + block_trace_gap_pct
+                # for the whole span, observed only after the checkpoint
+                # seam so its segment counts toward the block that paid
+                # it — same ordering as Miner.mine_chain. One grouping
+                # pass over the span's own records (every batch is one
+                # record, recovery re-mines add at most one each, plus
+                # the checkpoint record).
+                observe_batch_metrics(
+                    [start + j + 1 for j in range(mined)],
+                    profiler().records(tail=mined + 8))
 
     def _mine_span(self, n: int) -> int:
         """Dispatches ceil(n / blocks_per_call) fused device calls
@@ -229,6 +247,7 @@ class FusedMiner:
             # in-flight interval whose overlap with the append segments
             # is the pipelining evidence (docs/perfwatch.md).
             prec = profiler().dispatch(kind="fused", height=height, k=k)
+            t_open = prec.now()
             with prec.segment("enqueue"):
                 payloads = [self.config.payload(height + j + 1)
                             for j in range(k)]
@@ -244,36 +263,104 @@ class FusedMiner:
             # Heartbeat per dispatch: the fused loop's only host-side
             # progress point — /healthz watches the last_set age.
             heartbeat("miner_heartbeat").set(height)
-            batches.append((height, payloads, nonces, prec, prec.now()))
+            batches.append((height, payloads, nonces, prec, t_open,
+                            prec.now()))
             height += k
             remaining -= k
 
         while remaining > 0 and len(batches) < self.PIPELINE_DEPTH:
             dispatch_one()
         while batches:
-            batch_height, payloads, nonces, prec, t_issue = batches.pop(0)
+            (batch_height, payloads, nonces, prec, t_open,
+             t_issue) = batches.pop(0)
             nonces = replicated_host_value(nonces)
             prec.add_segment("device", t_issue, prec.now())
             if remaining > 0:
                 dispatch_one()
+            k = len(payloads)
+
+            def stamp_batch(n_appended: int) -> None:
+                # The fused twin of the per-block miner's
+                # block_latency_ms: one batch yields n blocks, so each
+                # is stamped the batch's dispatch-to-drained wall
+                # amortized over what it actually yielded — the honest
+                # per-block number a device-resident loop can produce,
+                # and the label keeps it a separate series from the
+                # per-block path (docs/observability.md catalogue).
+                if not n_appended:
+                    return
+                per_block_ms = (prec.now() - t_open) * 1e3 / n_appended
+                lat = histogram("block_latency_ms",
+                                help="wall-clock per mined block "
+                                     "(winner latency, ms)",
+                                backend="tpu-fused")
+                for _ in range(n_appended):
+                    lat.observe(per_block_ms)
+
             for j, payload in enumerate(payloads):
-                with prec.segment("validate"):
-                    cand = self.node.make_candidate(payload)
-                    winner = core.set_nonce(cand, int(nonces[j]))
-                with span("miner.append", height=batch_height + j + 1), \
-                        prec.segment("append"):
-                    accepted = self.node.submit(winner)
-                if not accepted:
-                    self._recover_block(batch_height + j + 1,
-                                        int(nonces[j]))
-                    return self.node.height - start
-                counter("blocks_mined_total",
-                        help="blocks mined and appended",
-                        backend="tpu-fused").inc()
-                self._log({"event": "block_mined", "backend": "tpu-fused",
-                           "height": batch_height + j + 1,
-                           "nonce": int(nonces[j]),
-                           "hash": self.node.tip_hash.hex()})
+                # Per-block trace frame around the drain work: the
+                # validate/append segments of THIS height inside the
+                # k-block batch record stay individually attributable
+                # in the critical-path join (blocktrace attribution
+                # rule 1).
+                with trace_block(batch_height + j + 1):
+                    with prec.segment("validate"):
+                        cand = self.node.make_candidate(payload)
+                        winner = core.set_nonce(cand, int(nonces[j]))
+                    with span("miner.append",
+                              height=batch_height + j + 1), \
+                            prec.segment("append"):
+                        accepted = self.node.submit(winner)
+                    if not accepted:
+                        # The j blocks already appended from this batch
+                        # still get their latency metrics before the
+                        # recovery bail-out.
+                        stamp_batch(j)
+                        # The rest of this batch and every queued
+                        # in-flight dispatch are discarded — their
+                        # heights will be re-mined after recovery, so
+                        # strip the dead records' block identity: the
+                        # critical-path join must not merge slices from
+                        # an abandoned dispatch into the re-mined
+                        # block's waterfall (the work stays visible as
+                        # unattributed, never silently dropped). The
+                        # exact per-segment stamps (validate/append of
+                        # appended blocks, and this failed attempt)
+                        # survive — that work is real.
+                        # Each record's meta is REBOUND to a fresh dict,
+                        # never mutated in place: the meshwatch shard
+                        # flusher thread shallow-copies records and may
+                        # be json-serializing the old meta concurrently
+                        # (rebinding is atomic under the GIL; in-place
+                        # del would crash its iteration). Key-guarded so
+                        # the telemetry-off shared null record is never
+                        # written.
+                        meta = prec.record.get("meta") or {}
+                        if "height" in meta:
+                            meta = dict(meta)
+                            if j:
+                                meta["k"] = j
+                            else:
+                                del meta["height"]
+                            prec.record["meta"] = meta
+                        for stale in batches:
+                            s_meta = stale[3].record.get("meta") or {}
+                            if "height" in s_meta:
+                                s_meta = {k_: v for k_, v in s_meta.items()
+                                          if k_ != "height"}
+                                stale[3].record["meta"] = s_meta
+                        self._recover_block(batch_height + j + 1,
+                                            int(nonces[j]))
+                        return self.node.height - start
+                    counter("blocks_mined_total",
+                            help="blocks mined and appended",
+                            backend="tpu-fused").inc()
+                    self._log({"event": "block_mined",
+                               "backend": "tpu-fused",
+                               "height": batch_height + j + 1,
+                               "nonce": int(nonces[j]),
+                               "hash": self.node.tip_hash.hex()})
+            stamp_batch(k)
         return self.node.height - start
 
     def _recover_block(self, height: int, device_nonce: int) -> None:

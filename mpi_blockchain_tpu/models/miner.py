@@ -13,6 +13,8 @@ from typing import Callable
 
 from .. import core
 from ..backend import MinerBackend, backend_from_config
+from ..blocktrace import trace_block
+from ..blocktrace.critical_path import observe_block_metrics
 from ..config import MAX_EXTRA_NONCE, MinerConfig, extend_payload
 from ..meshwatch.pipeline import profiler
 from ..telemetry import counter, heartbeat, histogram
@@ -75,48 +77,61 @@ class Miner:
         backend = self.backend.name
         t0 = time.perf_counter()
         tried = 0
-        with span("miner.block", height=height):
+        # The block's own live dispatch records, handed to the
+        # critical-path observation in mine_chain — zero ring rescan on
+        # the hot path (the checkpoint seam's segment_on_last lands in
+        # the newest of these same dicts, so it is visible there too).
+        self._trace_records = trace_records = []
+        with trace_block(height), span("miner.block", height=height):
             for extra_nonce in range(MAX_EXTRA_NONCE + 1):
                 # One pipeline-profiler dispatch per sweep: in this
                 # synchronous loop the device window IS the search call,
                 # so the report's bubble fraction directly prices the
-                # host tail between sweeps (docs/perfwatch.md).
-                prec = profiler().dispatch(kind="sweep", height=height,
-                                           backend=backend)
-                with prec.segment("enqueue"):
-                    cand = self.node.make_candidate(
-                        extend_payload(data, extra_nonce))
-                res = None
-                with span("miner.sweep", height=height,
-                          extra_nonce=extra_nonce), \
-                        prec.segment("device"):
-                    # Windows ascend, so the first one holding a
-                    # qualifier yields the lowest nonce in this miner's
-                    # assigned space — the same determinism rule, per
-                    # window set.
-                    for w_start, w_end in self.search_windows():
-                        res = self.backend.search(
-                            cand, self.config.difficulty_bits,
-                            start_nonce=w_start,
-                            max_count=w_end - w_start)
-                        # One inc per backend.search call — for a striped
-                        # elastic miner that is one per window, keeping
-                        # hashes_tried_total / mining_rounds_total an
-                        # honest per-sweep ratio.
-                        counter("mining_rounds_total",
-                                help="backend sweep rounds issued",
-                                backend=backend).inc()
-                        counter("hashes_tried_total",
-                                help="nonces evaluated across all sweeps",
-                                backend=backend).inc(res.hashes_tried)
-                        tried += res.hashes_tried
-                        # One stamp per window sweep (the whole space
-                        # for the default miner, one stripe slice for
-                        # the elastic one), so a wedged backend stalls
-                        # the /healthz watchdog even mid-candidate.
-                        heartbeat("miner_heartbeat").set(self.node.height)
-                        if res.nonce is not None:
-                            break
+                # host tail between sweeps (docs/perfwatch.md). The
+                # trace_block frame re-enters per template so rollover
+                # candidates stay distinguishable in the per-block join.
+                with trace_block(height, template=extra_nonce):
+                    prec = profiler().dispatch(kind="sweep", height=height,
+                                               backend=backend)
+                    trace_records.append(prec.record)
+                    with prec.segment("enqueue"):
+                        cand = self.node.make_candidate(
+                            extend_payload(data, extra_nonce))
+                    res = None
+                    with span("miner.sweep", height=height,
+                              extra_nonce=extra_nonce), \
+                            prec.segment("device"):
+                        # Windows ascend, so the first one holding a
+                        # qualifier yields the lowest nonce in this
+                        # miner's assigned space — the same determinism
+                        # rule, per window set.
+                        for w_start, w_end in self.search_windows():
+                            res = self.backend.search(
+                                cand, self.config.difficulty_bits,
+                                start_nonce=w_start,
+                                max_count=w_end - w_start)
+                            # One inc per backend.search call — for a
+                            # striped elastic miner that is one per
+                            # window, keeping hashes_tried_total /
+                            # mining_rounds_total an honest per-sweep
+                            # ratio.
+                            counter("mining_rounds_total",
+                                    help="backend sweep rounds issued",
+                                    backend=backend).inc()
+                            counter("hashes_tried_total",
+                                    help="nonces evaluated across all "
+                                         "sweeps",
+                                    backend=backend).inc(res.hashes_tried)
+                            tried += res.hashes_tried
+                            # One stamp per window sweep (the whole space
+                            # for the default miner, one stripe slice for
+                            # the elastic one), so a wedged backend
+                            # stalls the /healthz watchdog even
+                            # mid-candidate.
+                            heartbeat("miner_heartbeat").set(
+                                self.node.height)
+                            if res.nonce is not None:
+                                break
                 if res is None:
                     raise RuntimeError(
                         "search_windows yielded no nonce windows")
@@ -168,7 +183,19 @@ class Miner:
             rec = self.mine_block()
             records.append(rec)
             if on_block is not None:
-                on_block(rec)
+                # In-scope of the block's trace: the periodic checkpoint
+                # save's pipeline segment joins the block that paid it.
+                with trace_block(rec.height):
+                    on_block(rec)
+            # The block's own critical-path waterfall, observed only
+            # after the checkpoint seam so its segment counts toward
+            # the block's live block_critical_path_ms{stage} +
+            # block_trace_gap_pct — the live numbers agree with what
+            # `perfwatch critical-path` reads from the same records
+            # (in-memory math over the block's own record dicts;
+            # docs/observability.md §blocktrace).
+            observe_block_metrics(rec.height,
+                                  records=self._trace_records)
         return records
 
     # ---- aggregate metrics -------------------------------------------------

@@ -1,4 +1,5 @@
-"""CLI: python -m mpi_blockchain_tpu.perfwatch {record,check,report,serve}
+"""CLI: python -m mpi_blockchain_tpu.perfwatch
+{record,check,report,critical-path,serve}
 
 The perf-regression sentinel as a merge gate:
 
@@ -14,6 +15,12 @@ The perf-regression sentinel as a merge gate:
 
     # trajectory + roofline + span-attribution report
     python -m mpi_blockchain_tpu.perfwatch report
+
+    # per-block critical-path waterfall from a --mesh-obs shard dir
+    # (blocktrace; --trace exports Perfetto with the critical path as a
+    # highlighted flow)
+    python -m mpi_blockchain_tpu.perfwatch critical-path \\
+        --mesh-dir /tmp/mesh --height 12 --json
 
     # standalone endpoint (mine/sim/bench embed the same server via
     # --serve-metrics PORT); serves until interrupted
@@ -156,6 +163,46 @@ def cmd_report(args) -> int:
     if pipeline["dispatch_count"]:
         report["pipeline"] = pipeline
     print(json.dumps(report, sort_keys=True))
+    return 0
+
+
+def cmd_critical_path(args) -> int:
+    """Per-block critical-path attribution (blocktrace): joins pipeline
+    records mesh-wide (from --mesh-dir shards, or the in-process
+    profiler for embedded callers) into per-block waterfalls."""
+    from ..blocktrace.critical_path import critical_path_report, render_text
+
+    if args.mesh_dir:
+        from ..meshwatch.aggregate import read_shards
+        records = [r for s in read_shards(args.mesh_dir)
+                   for r in s.get("pipeline") or []]
+    else:
+        from ..meshwatch.pipeline import profiler
+        records = profiler().records()
+    report = critical_path_report(records, height=args.height)
+    if args.trace:
+        from ..blocktrace.export import to_critical_path_trace
+        trace = to_critical_path_trace(report, records)
+        pathlib.Path(args.trace).write_text(
+            json.dumps(trace, sort_keys=True))
+    if args.as_json:
+        out = {"event": "perfwatch_critical_path",
+               "source": str(args.mesh_dir) if args.mesh_dir
+               else "in-process", **report}
+        if args.trace:
+            out["trace"] = {"path": str(args.trace),
+                            "events": len(trace["traceEvents"])}
+        print(json.dumps(out, sort_keys=True))
+    else:
+        print(render_text(report))
+        if args.trace:
+            print(f"perfetto trace -> {args.trace} "
+                  f"({len(trace['traceEvents'])} events)",
+                  file=sys.stderr)
+    if args.height is not None and not report["blocks"]:
+        print(f"critical-path: no attributable segments for height "
+              f"{args.height}", file=sys.stderr)
+        return 1
     return 0
 
 
@@ -307,6 +354,23 @@ def main(argv: list[str] | None = None) -> int:
                             "numbers of a finished run live in its "
                             "shards)")
     p_rep.set_defaults(fn=cmd_report)
+
+    p_cp = sub.add_parser(
+        "critical-path",
+        help="per-block critical-path waterfall: per-stage wall, the "
+             "longest dependency chain, device/collective/host split, "
+             "gap accounting (blocktrace)")
+    p_cp.add_argument("--height", type=int, default=None,
+                      help="restrict to one block height")
+    p_cp.add_argument("--mesh-dir", metavar="DIR", default=None,
+                      help="read pipeline records from this --mesh-obs "
+                           "shard directory (default: the in-process "
+                           "profiler)")
+    p_cp.add_argument("--json", action="store_true", dest="as_json")
+    p_cp.add_argument("--trace", metavar="PATH", default=None,
+                      help="also write a Perfetto trace with the "
+                           "critical path as a highlighted flow")
+    p_cp.set_defaults(fn=cmd_critical_path)
 
     p_srv = sub.add_parser("serve", help="standalone metrics endpoint "
                                          "(until interrupted)")

@@ -40,6 +40,18 @@ DEFAULT_SPREAD_K = 2.0
 # scheduler weather.
 SECTION_FLOOR_PCT = {"cpu_np8": 60.0, "sim_adversarial": 60.0}
 
+# Sections gated by an ABSOLUTE bound on the metric value itself, not a
+# relative drop from the best prior: {section: max allowed value}.
+# trace_overhead is the telemetry observer-effect budget — always-on
+# tracing may cost at most 3% of sweep throughput (ISSUE 10 acceptance;
+# measured by blocktrace/overhead.py, wired through `make trace-smoke`).
+# trace_block_observe bounds the PER-BLOCK critical-path observation
+# (microseconds per observe_block_metrics call, measured in-situ) —
+# block-cadence work gets its own budget instead of polluting the
+# per-round sweep number with block-rate assumptions; ~90 us on the
+# reference box, 300 us budget.
+SECTION_BOUNDS = {"trace_overhead": 3.0, "trace_block_observe": 300.0}
+
 
 @dataclasses.dataclass(frozen=True)
 class Finding:
@@ -53,22 +65,33 @@ class Finding:
     baseline: float | None = None
     baseline_at: str | None = None
     delta_pct: float | None = None     # positive = worse, by direction
-    allowed_pct: float | None = None   # max(threshold, k*spread)
+    allowed_pct: float | None = None   # max(threshold, k*spread) | bound
     spread_pct: float | None = None
+    # WHICH allowance won the max (the threshold that actually applied):
+    # "threshold" | "spread" | "section-floor" | "absolute-bound".
+    basis: str | None = None
 
     def to_dict(self) -> dict:
         return {k: v for k, v in dataclasses.asdict(self).items()
                 if v is not None}
 
     def render(self) -> str:
+        """The text verdict, carrying the candidate-vs-baseline delta
+        AND the threshold that applied — the gate's arithmetic must be
+        auditable from the terminal, not only from --json."""
         if self.verdict == "insufficient-history":
             return f"{self.key}: insufficient history (1 entry)"
         arrow = {"regression": "REGRESSION", "improved": "improved",
                  "ok": "ok"}[self.verdict]
+        basis = f" [{self.basis}]" if self.basis else ""
+        if self.basis == "absolute-bound":
+            return (f"{self.key}: {arrow} {self.metric}="
+                    f"{self.candidate:g} vs bound {self.allowed_pct:g} "
+                    f"(absolute budget, no baseline){basis}")
         return (f"{self.key}: {arrow} {self.metric}={self.candidate:g} "
                 f"vs baseline {self.baseline:g} "
                 f"(delta {self.delta_pct:+.1f}%, positive = worse; "
-                f"allowed {self.allowed_pct:.1f}%)")
+                f"allowed {self.allowed_pct:.1f}%{basis})")
 
 
 def _delta_worse_pct(direction: str, baseline: float,
@@ -93,8 +116,12 @@ def _judge(key: str, baseline_pool: list[Entry], candidate: Entry,
     best = pick(baseline_pool, key=lambda e: e.value)
     delta = _delta_worse_pct(direction, best.value, candidate.value)
     spread = max(candidate.spread_pct, best.spread_pct)
-    allowed = max(threshold_pct, k * spread,
-                  SECTION_FLOOR_PCT.get(candidate.section, 0.0))
+    floor = SECTION_FLOOR_PCT.get(candidate.section, 0.0)
+    allowed = max(threshold_pct, k * spread, floor)
+    basis = ("section-floor" if allowed == floor and floor > threshold_pct
+             else "spread" if allowed == k * spread
+             and k * spread > threshold_pct
+             else "threshold")
     verdict = ("regression" if delta > allowed
                else "improved" if delta < 0 else "ok")
     return Finding(key=key, section=candidate.section, metric=metric,
@@ -103,7 +130,19 @@ def _judge(key: str, baseline_pool: list[Entry], candidate: Entry,
                    baseline_at=best.recorded_at,
                    delta_pct=round(delta, 2),
                    allowed_pct=round(allowed, 2),
-                   spread_pct=round(spread, 2))
+                   spread_pct=round(spread, 2), basis=basis)
+
+
+def _judge_bound(key: str, candidate: Entry) -> Finding:
+    """Absolute-bound sections (SECTION_BOUNDS): the metric VALUE must
+    stay under the budget — no baseline, no spread, no history needed."""
+    metric, _ = candidate.metric
+    bound = SECTION_BOUNDS[candidate.section]
+    verdict = "regression" if candidate.value > bound else "ok"
+    return Finding(key=key, section=candidate.section, metric=metric,
+                   direction="bounded", verdict=verdict,
+                   candidate=candidate.value,
+                   allowed_pct=bound, basis="absolute-bound")
 
 
 def check_history(store: HistoryStore,
@@ -118,10 +157,15 @@ def check_history(store: HistoryStore,
     Series whose section has direction None are skipped."""
     findings: list[Finding] = []
     for key, entries in sorted(store.by_key().items()):
+        ordered = sorted(entries, key=lambda e: e.recorded_at)
+        newest = ordered[-1]
+        if newest.section in SECTION_BOUNDS:
+            findings.append(_judge_bound(key, newest))
+            continue
         if entries[0].metric[1] is None:
             continue
-        *prior, newest = sorted(entries, key=lambda e: e.recorded_at)
-        findings.append(_judge(key, prior, newest, threshold_pct, k))
+        findings.append(_judge(key, ordered[:-1], newest,
+                               threshold_pct, k))
     return findings
 
 
@@ -132,14 +176,17 @@ def check_candidate(store: HistoryStore, section: str, payload: dict,
     check, only record when accepted) against the FULL history of its
     series."""
     spec = SECTION_METRICS.get(section)
-    if spec is None or spec[1] is None:
-        checked = sorted(s for s, (_, d) in SECTION_METRICS.items() if d)
+    if spec is None or (spec[1] is None and section not in SECTION_BOUNDS):
+        checked = sorted(s for s, (_, d) in SECTION_METRICS.items()
+                         if d or s in SECTION_BOUNDS)
         raise ValueError(f"section {section!r} is not regression-checked; "
                          f"have {checked}")
     if spec[0] not in payload:
         raise ValueError(f"payload lacks {section!r}'s metric {spec[0]!r}")
     cand = Entry(section=section, key=entry_key(section, payload),
                  recorded_at="", source="candidate", payload=dict(payload))
+    if section in SECTION_BOUNDS:
+        return _judge_bound(cand.key, cand)
     pool = [e for e in store.entries(section) if e.key == cand.key]
     return _judge(cand.key, pool, cand, threshold_pct, k)
 

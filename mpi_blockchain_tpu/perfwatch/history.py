@@ -22,6 +22,11 @@ wall-clock, lower is better):
     cpu_np8         hashes_per_sec            higher
     sim_adversarial steps_per_sec             higher
     utilization     (recorded, never checked: derived from sweep)
+    trace_overhead  overhead_pct — no relative direction (the number is
+                    measurement-noise-level run to run) but gated by an
+                    ABSOLUTE bound instead: detector.SECTION_BOUNDS caps
+                    it at 3%, the telemetry observer-effect budget
+                    (blocktrace/overhead.py)
 
 Seeding: ``seed_from_bench_rounds`` imports the repo's existing
 ``BENCH_r0*.json`` round records (fresh measurements only — ``cached``
@@ -50,6 +55,8 @@ SECTION_METRICS: dict[str, tuple[str, str | None]] = {
     "cpu_np8": ("hashes_per_sec", "higher"),
     "sim_adversarial": ("steps_per_sec", "higher"),
     "utilization": ("vpu_utilization_pct", None),
+    "trace_overhead": ("overhead_pct", None),
+    "trace_block_observe": ("block_observe_us", None),
 }
 
 _KEY_FIELDS = ("preset", "kernel", "mesh", "backend")

@@ -154,7 +154,21 @@ def guarded_collective(fn, *, site: str = "winner_select",
     t0 = time.monotonic()
     worker.inbox.put((fn, out))
     try:
-        kind, value = out.get(timeout=timeout_s)
+        # The wait is a `collective` pipeline segment on the newest
+        # dispatch record (stamped with the in-scope block trace), so
+        # the per-block critical path can price rendezvous waits
+        # separately from device compute — mesh builds/rebuilds happen
+        # outside any device window and would otherwise read as gap.
+        # Recorded even when the wait times out: that overhang is
+        # exactly the wait worth seeing.
+        from ..meshwatch.pipeline import profiler
+
+        # chained=False: the wait runs CONCURRENTLY with whatever the
+        # record's open stage is — backdating it to the previous stage
+        # boundary (the chained default) would stretch it over the
+        # whole device window.
+        with profiler().segment_on_last("collective", chained=False):
+            kind, value = out.get(timeout=timeout_s)
     except queue.Empty:
         elapsed = time.monotonic() - t0
         counter("collective_timeouts_total",

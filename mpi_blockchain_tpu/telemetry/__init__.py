@@ -36,14 +36,17 @@ import pathlib
 from .causal import (CausalLog, LamportClock,  # noqa: F401
                      dump_causal_logs, load_causal_dump)
 from .events import clear_events, emit_event, recent_events  # noqa: F401
-from .registry import (Counter, Gauge, Histogram, MetricError,  # noqa: F401
-                       Registry, default_registry, reset)
+from .registry import (NULL_METRIC, Counter, Gauge,  # noqa: F401
+                       Histogram, MetricError, Registry, default_registry,
+                       reset, set_telemetry_disabled, telemetry_disabled)
 from .spans import (Span, active_span, disable_perfetto,  # noqa: F401
                     enable_perfetto, perfetto_enabled, span)
 
 
 def counter(name: str, help: str = "", **labels) -> Counter:
     """Get-or-create a counter on the default registry."""
+    if telemetry_disabled():
+        return NULL_METRIC
     return default_registry().counter(name, help=help, **labels)
 
 
@@ -78,6 +81,8 @@ def _with_rank(labels: dict, rank: int | None) -> dict:
 def rank_counter(name: str, help: str = "", rank: int | None = None,
                  **labels) -> Counter:
     """A counter labeled with the mesh rank (this process's by default)."""
+    if telemetry_disabled():
+        return NULL_METRIC
     return default_registry().counter(name, help=help,
                                       **_with_rank(labels, rank))
 
@@ -85,6 +90,8 @@ def rank_counter(name: str, help: str = "", rank: int | None = None,
 def rank_gauge(name: str, help: str = "", rank: int | None = None,
                **labels) -> Gauge:
     """A gauge labeled with the mesh rank (this process's by default)."""
+    if telemetry_disabled():
+        return NULL_METRIC
     return default_registry().gauge(name, help=help,
                                     **_with_rank(labels, rank))
 
@@ -92,12 +99,16 @@ def rank_gauge(name: str, help: str = "", rank: int | None = None,
 def rank_histogram(name: str, help: str = "", rank: int | None = None,
                    **labels) -> Histogram:
     """A histogram labeled with the mesh rank (this process's by default)."""
+    if telemetry_disabled():
+        return NULL_METRIC
     return default_registry().histogram(name, help=help,
                                         **_with_rank(labels, rank))
 
 
 def gauge(name: str, help: str = "", **labels) -> Gauge:
     """Get-or-create a gauge on the default registry."""
+    if telemetry_disabled():
+        return NULL_METRIC
     return default_registry().gauge(name, help=help, **labels)
 
 
@@ -111,6 +122,8 @@ def heartbeat(name: str) -> Gauge:
         raise MetricError(f"heartbeat gauge {name!r} must end "
                           f"'_heartbeat' (the /healthz watchdog matches "
                           f"on the suffix)")
+    if telemetry_disabled():
+        return NULL_METRIC
     return default_registry().gauge(
         name, help="progress heartbeat (value: progress marker; "
                    "last_set age: staleness)")
@@ -118,6 +131,8 @@ def heartbeat(name: str) -> Gauge:
 
 def histogram(name: str, help: str = "", **labels) -> Histogram:
     """Get-or-create a histogram on the default registry."""
+    if telemetry_disabled():
+        return NULL_METRIC
     return default_registry().histogram(name, help=help, **labels)
 
 

@@ -60,9 +60,26 @@ _seq = 0
 
 
 def emit_event(record: dict) -> None:
-    """Emits one structured event as a JSON line (INFO) + rings it."""
+    """Emits one structured event as a JSON line (INFO) + rings it.
+
+    Inside a ``blocktrace.trace_block`` scope the record is stamped with
+    a ``trace`` dict (height/template/rank) unless it already carries
+    one — retry, degradation, collective-timeout, and checkpoint events
+    thereby join the block that suffered them. With
+    ``MPIBT_TELEMETRY_OFF`` the event is dropped entirely (the
+    trace_overhead audit's off leg)."""
+    from .registry import telemetry_disabled
+
+    if telemetry_disabled():
+        return
+    from ..blocktrace.context import trace_dict
     from ..utils.logging import get_logger
 
+    record = dict(record)
+    if "trace" not in record:
+        trace = trace_dict()
+        if trace is not None:
+            record["trace"] = trace
     global _seq
     with _lock:
         _seq += 1

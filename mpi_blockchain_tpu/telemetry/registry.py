@@ -25,6 +25,7 @@ different metric kind raises ``MetricError``.
 from __future__ import annotations
 
 import collections
+import os
 import random
 import threading
 import time
@@ -32,6 +33,32 @@ import zlib
 from typing import Iterable
 
 LabelItems = tuple[tuple[str, str], ...]
+
+# ---- the kill switch (telemetry self-overhead audit) ----------------------
+# MPIBT_TELEMETRY_OFF turns every telemetry emit point into a no-op: the
+# module-level helpers (telemetry.counter/gauge/histogram/heartbeat)
+# hand out a shared null metric, spans skip timing and filing, the event
+# stream drops records, and the pipeline profiler records nothing. This
+# is NOT an operational mode — it exists so the `trace_overhead` bench
+# section (blocktrace/overhead.py) can price the instrumentation itself
+# as an instrumented-vs-off throughput delta, gated < 3% by `perfwatch
+# check`. Direct Registry method calls stay live (the registry object is
+# still real); only the sanctioned emit-point helpers check the flag.
+
+_telemetry_off = bool(os.environ.get("MPIBT_TELEMETRY_OFF"))
+
+
+def telemetry_disabled() -> bool:
+    return _telemetry_off
+
+
+def set_telemetry_disabled(flag: bool) -> bool:
+    """Flips the kill switch; returns the previous state (the overhead
+    audit and tests restore it in a finally)."""
+    global _telemetry_off
+    prev = _telemetry_off
+    _telemetry_off = bool(flag)
+    return prev
 
 # Finished spans kept for inspection (telemetry CLI / tests); bounded so a
 # long mining run cannot grow the registry without limit.
@@ -260,6 +287,45 @@ class Histogram(_Metric):
     def to_dict(self) -> dict:
         return {"kind": self.kind, "labels": dict(self.labels),
                 **self.snapshot()}
+
+
+class _NullMetric:
+    """The shared do-nothing metric the helpers hand out while telemetry
+    is off: accepts every mutation of every kind, records nothing."""
+
+    kind = "null"
+    name = "null"
+    labels: LabelItems = ()
+    value = 0
+    count = 0
+    sum = 0.0
+
+    def inc(self, amount: float = 1) -> None:
+        pass
+
+    def dec(self, amount: float = 1) -> None:
+        pass
+
+    def set(self, value: float) -> None:
+        pass
+
+    def observe(self, value: float) -> None:
+        pass
+
+    def age_s(self) -> None:
+        return None
+
+    def quantile(self, q: float) -> None:
+        return None
+
+    def snapshot(self) -> dict:
+        return {}
+
+    def to_dict(self) -> dict:
+        return {"kind": self.kind, "labels": {}, "value": 0}
+
+
+NULL_METRIC = _NullMetric()
 
 
 # Prometheus TYPE keyword per metric kind (histograms render as summaries:

@@ -31,10 +31,14 @@ import threading
 import time
 import warnings
 
-from .registry import Registry, default_registry
+from .registry import Registry, default_registry, telemetry_disabled
 
 _tls = threading.local()
 _perfetto_enabled = False
+
+#: The span yielded while telemetry is off: attribute-compatible,
+#: shared, never filed.
+_NULL_SPAN = None  # assigned below Span's definition
 
 
 @dataclasses.dataclass
@@ -49,6 +53,9 @@ class Span:
         return {"name": self.name, "parent": self.parent,
                 "depth": self.depth, "attrs": dict(self.attrs),
                 "duration_s": self.duration_s}
+
+
+_NULL_SPAN = Span(name="telemetry-off")
 
 
 def _stack() -> list:
@@ -108,6 +115,11 @@ def _annotation(name: str):
 def span(name: str, registry: Registry | None = None, **attrs):
     """Context manager timing one named operation (host-side only —
     chainlint JAX006 forbids this inside jit-traced functions)."""
+    if telemetry_disabled():
+        # The trace_overhead audit's off leg: no clock reads, no stack
+        # push, nothing filed — the span becomes a bare yield.
+        yield _NULL_SPAN
+        return
     stack = _stack()
     parent = stack[-1].name if stack else None
     s = Span(name=name, parent=parent, depth=len(stack), attrs=attrs)

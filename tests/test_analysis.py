@@ -423,6 +423,90 @@ def test_tel003_cli_pass_family(tmp_path):
     assert "TEL003" in proc.stdout
 
 
+# ---- TEL004: block-trace threading at mining dispatch emit points ------
+
+
+DISPATCH_EMITS = textwrap.dedent("""\
+    from mpi_blockchain_tpu.meshwatch.pipeline import profiler
+    from mpi_blockchain_tpu.meshwatch.pipeline import profiler as _profiler
+
+
+    def emit(height, meta):
+        profiler().dispatch(kind="sweep")               # no identity
+        profiler().dispatch(kind="fused", k=4)          # k but no height
+        _profiler().dispatch(kind="warmup")             # aliased import
+        profiler().dispatch(kind="sweep", height=height)   # threaded
+        profiler().dispatch(kind="fused", **meta)       # opaque spread
+        profiler().records()                            # not an emit
+    """)
+
+
+def test_tel004_heightless_dispatch_fires(tmp_path):
+    from mpi_blockchain_tpu.analysis.telemetry_lint import run_telemetry_lint
+
+    bad = tmp_path / "dispatch_emits.py"
+    bad.write_text(DISPATCH_EMITS)
+    findings = run_telemetry_lint(
+        ROOT, overrides={"blocktrace_scope_files": [bad],
+                         "telemetry_files": []})
+    assert rule_set(findings) == {"TEL004"}
+    assert len(findings) == 3                 # height= and ** pass
+    assert all("unattributed" in f.message for f in findings)
+
+
+def test_tel004_out_of_scope_file_not_checked(tmp_path):
+    from mpi_blockchain_tpu.analysis.telemetry_lint import run_telemetry_lint
+
+    bad = tmp_path / "dispatch_emits.py"
+    bad.write_text(DISPATCH_EMITS)
+    findings = run_telemetry_lint(
+        ROOT, overrides={"blocktrace_scope_files": [],
+                         "telemetry_files": [bad]})
+    assert "TEL004" not in rule_set(findings)
+
+
+def test_tel004_inline_suppression(tmp_path):
+    suppressed = DISPATCH_EMITS.replace(
+        'profiler().dispatch(kind="sweep")               # no identity',
+        'profiler().dispatch(kind="sweep")  # chainlint: disable=TEL004')
+    bad = tmp_path / "dispatch_emits.py"
+    bad.write_text(suppressed)
+    findings = run_all(root=tmp_path, passes=["telemetry"],
+                       overrides={"blocktrace_scope_files": [bad],
+                                  "telemetry_files": [],
+                                  "sim_py": SIM_PY})
+    assert len([f for f in findings if f.rule == "TEL004"]) == 2
+
+
+def test_tel004_live_tree_clean():
+    """Every mining-loop dispatch emit point threads a block identity,
+    and the live scope actually covers the mining surfaces."""
+    from mpi_blockchain_tpu.analysis.telemetry_lint import (
+        _blocktrace_scope_files, run_telemetry_lint)
+
+    rels = {str(p.relative_to(ROOT)) for p in _blocktrace_scope_files(ROOT)}
+    for expected in ("mpi_blockchain_tpu/models/miner.py",
+                     "mpi_blockchain_tpu/models/fused.py",
+                     "mpi_blockchain_tpu/resilience/elastic.py",
+                     "mpi_blockchain_tpu/cli.py"):
+        assert expected in rels, expected
+    findings = [f for f in run_telemetry_lint(ROOT)
+                if f.rule == "TEL004"]
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_tel004_cli_pass_family(tmp_path):
+    bad = tmp_path / "dispatch_emits.py"
+    bad.write_text(DISPATCH_EMITS)
+    proc = subprocess.run(
+        [sys.executable, "-m", "mpi_blockchain_tpu.analysis",
+         "--passes", "telemetry", "--override",
+         f"blocktrace_scope_files={bad}"],
+        cwd=ROOT, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "TEL004" in proc.stdout
+
+
 def test_tel002_cli_pass_family(tmp_path):
     bad = tmp_path / "bad_metrics.py"
     bad.write_text(BAD_METRICS)

@@ -1,0 +1,753 @@
+"""blocktrace subsystem tests (mpi_blockchain_tpu/blocktrace).
+
+Covers the block trace context (thread-local stack, template
+inheritance, rank defaulting, the telemetry kill switch), the stamping
+seams (pipeline segments, dispatch meta defaulting, emit_event trace
+dicts, segment chaining), the critical-path analyzer's attribution
+rules and its conservation property — for every (block, rank),
+``sum(stages) + gap == wall`` with no double-count, including pipelined
+overlap, synthetic overlapping segment sets, and a rank whose shard
+goes missing mid-block — the straggler rollup, report determinism, the
+Perfetto export's highlighted flow, the per-block metrics, the
+telemetry self-overhead audit + MPIBT_TELEMETRY_OFF semantics, the
+perfwatch detector's absolute-bound gate, the fused drain loop's
+block_latency_ms satellite, and the `perfwatch critical-path` CLI.
+"""
+import json
+import pathlib
+import random
+import threading
+
+import pytest
+
+from mpi_blockchain_tpu import telemetry
+from mpi_blockchain_tpu.blocktrace import (BlockTrace, current_trace,
+                                           trace_block, trace_dict)
+from mpi_blockchain_tpu.blocktrace.critical_path import (
+    COMPLETE_GAP_PCT, critical_path_report, observe_batch_metrics,
+    observe_block_metrics, render_text, segments_by_block)
+from mpi_blockchain_tpu.blocktrace.export import (CRITICAL_PID,
+                                                  to_critical_path_trace)
+from mpi_blockchain_tpu.meshwatch.pipeline import profiler, reset_profiler
+from mpi_blockchain_tpu.telemetry.registry import (set_telemetry_disabled,
+                                                   telemetry_disabled)
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+STAGE_NAMES = ("enqueue", "device", "collective", "validate", "append",
+               "checkpoint")
+
+
+@pytest.fixture(autouse=True)
+def fresh_state():
+    telemetry.reset()
+    telemetry.clear_events()
+    telemetry.set_mesh_rank(0)
+    reset_profiler()
+    set_telemetry_disabled(False)
+    yield
+    telemetry.reset()
+    telemetry.clear_events()
+    telemetry.set_mesh_rank(0)
+    reset_profiler()
+    set_telemetry_disabled(False)
+
+
+def rec(rank=0, meta=None, segments=(), dispatch=0):
+    return {"dispatch": dispatch, "rank": rank, "meta": dict(meta or {}),
+            "segments": [dict(s) for s in segments]}
+
+
+def seg(stage, t0, t1, height=None):
+    s = {"stage": stage, "t0": t0, "t1": t1}
+    if height is not None:
+        s["height"] = height
+    return s
+
+
+def assert_conserved(block):
+    """The conservation property: stages + gap == wall, exactly one
+    owner per instant (so the total can never exceed the wall)."""
+    total = sum(block["stages_ms"].values()) + block["gap_ms"]
+    assert total == pytest.approx(block["wall_ms"], abs=1e-2)
+    chain_ms = sum(r["ms"] for r in block["critical_path"])
+    assert chain_ms == pytest.approx(block["wall_ms"] - block["gap_ms"],
+                                     abs=1e-2)
+
+
+# ---- the block trace context -------------------------------------------
+
+
+def test_trace_block_stack_semantics():
+    assert current_trace() is None and trace_dict() is None
+    with trace_block(7) as outer:
+        assert outer == BlockTrace(height=7, template=0, rank=0)
+        assert current_trace() == outer
+        with trace_block(8, template=2, rank=3) as inner:
+            assert current_trace() == inner
+            assert trace_dict() == {"height": 8, "template": 2, "rank": 3}
+        assert current_trace() == outer
+    assert current_trace() is None
+
+
+def test_trace_block_template_inherits_within_same_height():
+    with trace_block(5, template=3):
+        with trace_block(5) as inner:          # re-entering height 5
+            assert inner.template == 3
+        with trace_block(6) as other:          # different height: fresh
+            assert other.template == 0
+
+
+def test_trace_block_rank_defaults_from_mesh_rank():
+    telemetry.set_mesh_rank(4)
+    with trace_block(1) as t:
+        assert t.rank == 4
+
+
+def test_trace_block_thread_isolation():
+    seen = {}
+
+    def worker():
+        seen["inner"] = current_trace()
+        with trace_block(99):
+            seen["pushed"] = current_trace()
+
+    with trace_block(1):
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+        assert current_trace().height == 1
+    assert seen["inner"] is None            # main's frame is invisible
+    assert seen["pushed"].height == 99
+
+
+def test_trace_block_bare_yield_when_telemetry_off():
+    set_telemetry_disabled(True)
+    with trace_block(5) as t:
+        assert t is None
+        assert current_trace() is None
+
+
+# ---- stamping seams -----------------------------------------------------
+
+
+def test_dispatch_meta_defaults_height_from_trace():
+    with trace_block(11):
+        prec = profiler().dispatch(kind="sweep", backend="cpu")
+        with prec.segment("device"):
+            pass
+    r = profiler().records()[-1]
+    assert r["meta"]["height"] == 11
+    assert r["segments"][0]["height"] == 11
+
+
+def test_explicit_height_beats_trace_default():
+    with trace_block(11):
+        profiler().dispatch(kind="fused", height=30, k=4)
+    assert profiler().records()[-1]["meta"]["height"] == 30
+
+
+def test_segments_stamp_height_and_nonzero_template():
+    prec = profiler().dispatch(kind="sweep", height=3)
+    with trace_block(3, template=2):
+        prec.add_segment("validate", 1.0, 2.0)
+    prec.add_segment("append", 2.0, 3.0)       # out of scope: no stamp
+    segs = profiler().records()[-1]["segments"]
+    assert segs[0]["height"] == 3 and segs[0]["template"] == 2
+    assert "height" not in segs[1]
+
+
+def test_segment_chaining_closes_instrumentation_seams():
+    prec = profiler().dispatch(kind="sweep", height=1)
+    with prec.segment("enqueue"):
+        pass
+    with prec.segment("validate"):
+        pass
+    segs = profiler().records()[-1]["segments"]
+    assert segs[1]["t0"] == segs[0]["t1"]      # no inter-stage sliver
+
+
+def test_emit_event_stamps_trace_unless_already_carried():
+    with trace_block(21, rank=1):
+        telemetry.emit_event({"event": "retry"})
+        telemetry.emit_event({"event": "own", "trace": {"height": 9}})
+    telemetry.emit_event({"event": "outside"})
+    by_name = {e["event"]: e for e in telemetry.recent_events()}
+    assert by_name["retry"]["trace"] == {"height": 21, "template": 0,
+                                         "rank": 1}
+    assert by_name["own"]["trace"] == {"height": 9}
+    assert "trace" not in by_name["outside"]
+
+
+# ---- attribution rules --------------------------------------------------
+
+
+def test_own_stamp_wins_over_record_meta():
+    blocks, unattributed = segments_by_block(
+        [rec(meta={"height": 3},
+             segments=[seg("validate", 1.0, 2.0, height=9)])])
+    assert unattributed == 0
+    assert set(blocks) == {9}
+    sl = blocks[9][0][0]
+    assert (sl["t0"], sl["t1"], sl["estimated"]) == (1.0, 2.0, False)
+
+
+def test_meta_height_alone_joins_that_height_exact():
+    blocks, _ = segments_by_block(
+        [rec(meta={"height": 5}, segments=[seg("device", 0.0, 1.0)])])
+    assert set(blocks) == {5}
+    assert blocks[5][0][0]["estimated"] is False
+
+
+def test_fused_batch_estimated_sequential_split():
+    """meta height+k: block height+j+1 gets [t0 + j*step, END]."""
+    blocks, _ = segments_by_block(
+        [rec(meta={"height": 4, "k": 2},
+             segments=[seg("device", 0.0, 0.010)])])
+    assert set(blocks) == {5, 6}
+    first, second = blocks[5][0][0], blocks[6][0][0]
+    assert (first["t0"], first["t1"]) == (0.0, 0.010)
+    assert second["t0"] == pytest.approx(0.005)
+    assert second["t1"] == 0.010               # tail is part of ITS wall
+    assert first["estimated"] and second["estimated"]
+
+
+def test_fused_k1_batch_joins_next_height_exact():
+    """k == 1 involves no sequential split, so the slice is exact."""
+    blocks, _ = segments_by_block(
+        [rec(meta={"height": 4, "k": 1},
+             segments=[seg("device", 0.0, 1.0)])])
+    assert set(blocks) == {5}
+    assert blocks[5][0][0]["estimated"] is False
+
+
+def test_identityless_segments_counted_unattributed():
+    blocks, unattributed = segments_by_block(
+        [rec(meta={"kind": "warmup"},
+             segments=[seg("device", 0.0, 1.0), seg("enqueue", 1.0, 2.0)])])
+    assert blocks == {} and unattributed == 2
+
+
+# ---- conservation: stages + gap == wall, no double-count ----------------
+
+
+def test_conservation_pipelined_overlap_device_owns_instant():
+    """Host work hidden behind the in-flight device window costs
+    nothing: the device owns every overlapped instant."""
+    report = critical_path_report(
+        [rec(meta={"height": 1},
+             segments=[seg("device", 0.0, 0.010),
+                       seg("validate", 0.002, 0.004),
+                       seg("append", 0.004, 0.006)])])
+    b = report["blocks"]["1"]
+    assert b["stages_ms"] == {"device": 10.0}
+    assert b["gap_ms"] == 0.0 and b["wall_ms"] == 10.0
+    assert b["critical_path"] == [
+        {"stage": "device", "rank": 0, "start_ms": 0.0, "ms": 10.0}]
+    assert_conserved(b)
+
+
+def test_conservation_gap_between_stages():
+    report = critical_path_report(
+        [rec(meta={"height": 1},
+             segments=[seg("enqueue", 0.0, 0.001),
+                       seg("device", 0.002, 0.008)])])
+    b = report["blocks"]["1"]
+    assert b["wall_ms"] == 8.0
+    assert b["gap_ms"] == pytest.approx(1.0)
+    assert b["gap_pct"] == pytest.approx(12.5)
+    assert not b["complete"]
+    assert_conserved(b)
+
+
+def test_conservation_partial_overlap_splits_ownership():
+    """device [0,6ms] overlapping validate [4,10ms]: the device owns
+    [0,6), validate owns only its exclusive [6,10) remainder."""
+    report = critical_path_report(
+        [rec(meta={"height": 2},
+             segments=[seg("device", 0.0, 0.006),
+                       seg("validate", 0.004, 0.010)])])
+    b = report["blocks"]["2"]
+    assert b["stages_ms"] == {"device": 6.0, "validate": 4.0}
+    assert b["gap_ms"] == 0.0
+    assert [r["stage"] for r in b["critical_path"]] == ["device",
+                                                        "validate"]
+    assert_conserved(b)
+
+
+@pytest.mark.parametrize("seed", range(10))
+def test_conservation_property_random_overlapping_sets(seed):
+    """Property-style: synthetic overlapping segment soups — arbitrary
+    stages (known + unknown), overlaps, nesting, idle holes — always
+    conserve, and the critical path tiles wall minus gap."""
+    rng = random.Random(seed)
+    segments = []
+    t = rng.uniform(0.0, 100.0)
+    for _ in range(rng.randint(3, 14)):
+        stage = rng.choice(STAGE_NAMES + ("mystery", "device"))
+        t0 = t + rng.uniform(-0.004, 0.004)
+        t1 = t0 + rng.uniform(0.0002, 0.012)
+        segments.append(seg(stage, t0, t1, height=7))
+        t = t0 + rng.uniform(0.0, 0.014)       # may overlap, may gap
+    report = critical_path_report([rec(meta={}, segments=segments)])
+    b = report["blocks"]["7"]
+    assert_conserved(b)
+    # runs are in time order and never touch two stages at once
+    starts = [r["start_ms"] for r in b["critical_path"]]
+    assert starts == sorted(starts)
+
+
+def test_conservation_per_rank_with_shard_missing_mid_block():
+    """Rank 1's shard vanishes mid-run (mined block 1, nothing for
+    block 2): block 1 still rolls up both ranks, block 2 is judged on
+    the evidence that exists — per-rank conservation throughout."""
+    records = [
+        rec(rank=0, meta={"height": 1},
+            segments=[seg("device", 0.0, 0.010),
+                      seg("append", 0.010, 0.011)]),
+        rec(rank=1, meta={"height": 1},
+            segments=[seg("device", 0.0, 0.020),
+                      seg("append", 0.020, 0.021)]),
+        rec(rank=0, meta={"height": 2},
+            segments=[seg("device", 0.030, 0.040)]),
+    ]
+    report = critical_path_report(records)
+    assert report["heights"] == [1, 2]
+    b1 = report["blocks"]["1"]
+    assert set(b1["ranks"]) == {"0", "1"}
+    assert b1["critical_rank"] == 1            # straggler owns headline
+    assert b1["wall_ms"] == b1["ranks"]["1"]["wall_ms"] == 21.0
+    b2 = report["blocks"]["2"]
+    assert set(b2["ranks"]) == {"0"} and b2["critical_rank"] == 0
+    for b in (b1, b2):
+        for wf in b["ranks"].values():
+            assert_conserved(wf)
+        assert_conserved(b)
+
+
+def test_stage_priority_device_over_collective_over_host():
+    report = critical_path_report(
+        [rec(meta={"height": 1},
+             segments=[seg("collective", 0.0, 0.010),
+                       seg("device", 0.002, 0.004),
+                       seg("checkpoint", 0.008, 0.012)])])
+    b = report["blocks"]["1"]
+    assert b["stages_ms"] == {"collective": 8.0, "device": 2.0,
+                              "checkpoint": 2.0}
+    assert b["split"]["device_ms"] == 2.0
+    assert b["split"]["collective_ms"] == 8.0
+    assert b["split"]["host_ms"] == 2.0
+    assert_conserved(b)
+
+
+# ---- report shape, determinism, rendering -------------------------------
+
+
+def test_report_determinism_across_record_order():
+    rng = random.Random(3)
+    records = []
+    for i in range(12):
+        h = rng.randint(1, 4)
+        t0 = rng.uniform(0, 1)
+        records.append(rec(rank=i % 3, meta={"height": h}, dispatch=i,
+                           segments=[seg("device", t0, t0 + 0.01),
+                                     seg("append", t0 + 0.01,
+                                         t0 + 0.012)]))
+    base = json.dumps(critical_path_report(records), sort_keys=True)
+    for variant in (list(reversed(records)),
+                    sorted(records, key=lambda r: r["rank"])):
+        assert json.dumps(critical_path_report(variant),
+                          sort_keys=True) == base
+
+
+def test_report_height_filter_and_empty():
+    records = [rec(meta={"height": 2},
+                   segments=[seg("device", 0.0, 1.0)])]
+    only = critical_path_report(records, height=2)
+    assert only["heights"] == [2]
+    missing = critical_path_report(records, height=9)
+    assert missing["heights"] == [] and missing["blocks"] == {}
+
+
+def test_render_text_carries_waterfall_and_unattributed():
+    records = [rec(meta={"height": 3},
+                   segments=[seg("device", 0.0, 0.010),
+                             seg("append", 0.010, 0.011)]),
+               rec(meta={}, segments=[seg("enqueue", 0.0, 1.0)])]
+    text = render_text(critical_path_report(records))
+    assert "block 3" in text and "critical path:" in text
+    assert "device" in text and "append" in text
+    assert "1 segment(s)" in text
+    assert "no attributable blocks" in render_text(critical_path_report([]))
+
+
+# ---- Perfetto export ----------------------------------------------------
+
+
+def _two_block_records():
+    return [rec(rank=0, meta={"height": 1}, dispatch=0,
+                segments=[seg("enqueue", 100.0, 100.001),
+                          seg("device", 100.001, 100.010),
+                          seg("append", 100.010, 100.012)]),
+            rec(rank=0, meta={"height": 2}, dispatch=1,
+                segments=[seg("device", 100.020, 100.030),
+                          seg("append", 100.030, 100.031)])]
+
+
+def test_export_critical_path_row_and_flow_chain():
+    records = _two_block_records()
+    report = critical_path_report(records)
+    trace = json.loads(json.dumps(to_critical_path_trace(report, records)))
+    cp = [e for e in trace["traceEvents"] if e.get("pid") == CRITICAL_PID]
+    slices = [e for e in cp if e["ph"] == "X"]
+    assert {e["args"]["height"] for e in slices} == {1, 2}
+    # per block: a flow start and finish bound to its runs, no dangler
+    for h in (1, 2):
+        flows = [e for e in cp if e["ph"] in ("s", "t", "f")
+                 and e.get("id") == h]
+        phs = [e["ph"] for e in flows]
+        assert phs[0] == "s" and phs[-1] == "f"
+        assert set(phs[1:-1]) <= {"t"}
+    names = [e for e in cp if e["ph"] == "M"]
+    assert any(e["args"]["name"] == "critical path" for e in names)
+
+
+def test_export_single_run_block_has_no_dangling_flow():
+    records = [rec(meta={"height": 1},
+                   segments=[seg("device", 100.0, 100.010)])]
+    report = critical_path_report(records)
+    trace = to_critical_path_trace(report, records)
+    cp = [e for e in trace["traceEvents"] if e.get("pid") == CRITICAL_PID]
+    assert [e["ph"] for e in cp if e["ph"] in ("s", "t", "f")] == []
+    assert len([e for e in cp if e["ph"] == "X"]) == 1
+
+
+def test_export_empty_record_set_degrades_to_base_trace():
+    trace = to_critical_path_trace(critical_path_report([]), [])
+    assert all(e.get("pid") != CRITICAL_PID
+               for e in trace.get("traceEvents", []))
+
+
+# ---- per-block metrics --------------------------------------------------
+
+
+def test_observe_block_metrics_stamps_histograms():
+    records = [rec(meta={"height": 6},
+                   segments=[seg("device", 0.0, 0.010),
+                             seg("append", 0.010, 0.012)])]
+    wf = observe_block_metrics(6, records=records)
+    assert wf["wall_ms"] == 12.0
+    dev = telemetry.histogram("block_critical_path_ms", stage="device")
+    app = telemetry.histogram("block_critical_path_ms", stage="append")
+    gap = telemetry.histogram("block_trace_gap_pct")
+    assert dev.count == 1 and dev.sum == pytest.approx(10.0)
+    assert app.count == 1 and app.sum == pytest.approx(2.0)
+    assert gap.count == 1 and gap.sum == pytest.approx(0.0)
+
+
+def test_observe_block_metrics_none_when_unattributable():
+    assert observe_block_metrics(42, records=[]) is None
+    assert telemetry.histogram("block_trace_gap_pct").count == 0
+
+
+def test_observe_batch_metrics_one_pass_for_k_blocks():
+    records = [rec(meta={"height": 0, "k": 2},
+                   segments=[seg("device", 0.0, 0.010)])]
+    out = observe_batch_metrics([1, 2, 3], records)
+    assert set(out) == {1, 2}
+    assert telemetry.histogram("block_trace_gap_pct").count == 2
+
+
+def test_observe_block_metrics_noop_when_telemetry_off():
+    set_telemetry_disabled(True)
+    records = [rec(meta={"height": 6},
+                   segments=[seg("device", 0.0, 0.010)])]
+    assert observe_block_metrics(6, records=records) is None
+
+
+# ---- the telemetry kill switch ------------------------------------------
+
+
+def test_kill_switch_nulls_every_emit_point():
+    from mpi_blockchain_tpu.telemetry.registry import NULL_METRIC
+    from mpi_blockchain_tpu.telemetry.spans import span
+
+    prev = set_telemetry_disabled(True)
+    try:
+        assert telemetry_disabled()
+        assert telemetry.counter("x_total") is NULL_METRIC
+        assert telemetry.gauge("x_g") is NULL_METRIC
+        assert telemetry.histogram("x_ms") is NULL_METRIC
+        assert telemetry.heartbeat("x_heartbeat") is NULL_METRIC
+        telemetry.emit_event({"event": "dropped"})
+        assert telemetry.recent_events() == []
+        with span("off.leg") as s:
+            assert s.name == "telemetry-off"
+        prec = profiler().dispatch(kind="sweep", height=1)
+        with prec.segment("device"):
+            pass
+        assert prec.now() > 0                  # the clock stays real
+        assert profiler().records() == []
+    finally:
+        set_telemetry_disabled(prev)
+    assert not telemetry_disabled()
+    # back on: real metrics again, registry untouched by the off leg
+    telemetry.counter("x_total").inc()
+    assert telemetry.counter("x_total").value == 1
+
+
+# ---- the self-overhead audit --------------------------------------------
+
+
+def test_measure_trace_overhead_payload_shape():
+    from mpi_blockchain_tpu.blocktrace.overhead import measure_trace_overhead
+
+    payload = measure_trace_overhead(seconds=0.02, reps=2, chunk_pow2=12)
+    assert payload["backend"] == "cpu"
+    assert payload["reps"] == 2
+    assert payload["hashes_per_sec_instrumented"] > 0
+    assert payload["hashes_per_sec_off"] > 0
+    assert len(payload["all_overhead_pct"]) == 2
+    assert payload["spread_pct"] >= 0
+    # the audit must restore the kill switch and leak no real telemetry
+    assert not telemetry_disabled()
+    assert profiler().records() == []
+
+
+def test_overhead_audit_gated_by_absolute_bound(tmp_path):
+    from mpi_blockchain_tpu.perfwatch.detector import check_candidate
+    from mpi_blockchain_tpu.perfwatch.history import HistoryStore
+
+    store = HistoryStore(tmp_path / "hist.jsonl")   # empty: no baseline
+    over = check_candidate(store, "trace_overhead",
+                           {"overhead_pct": 4.2, "backend": "cpu"})
+    assert over.verdict == "regression" and over.basis == "absolute-bound"
+    assert "bound" in over.render() and "4.2" in over.render()
+    ok = check_candidate(store, "trace_overhead",
+                         {"overhead_pct": -0.3, "backend": "cpu"})
+    assert ok.verdict == "ok"
+    neg = check_candidate(store, "trace_overhead",
+                          {"overhead_pct": 2.9, "backend": "cpu"})
+    assert neg.verdict == "ok"                     # under budget passes
+
+
+def test_check_history_judges_trace_overhead_entries(tmp_path):
+    from mpi_blockchain_tpu.perfwatch.detector import check_history
+    from mpi_blockchain_tpu.perfwatch.history import HistoryStore
+
+    store = HistoryStore(tmp_path / "hist.jsonl")
+    store.record("trace_overhead", {"overhead_pct": 0.5, "backend": "cpu"},
+                 source="test")
+    store.record("trace_overhead", {"overhead_pct": 7.5, "backend": "cpu"},
+                 source="test")
+    findings = check_history(store)
+    mine = [f for f in findings if f.section == "trace_overhead"]
+    assert len(mine) == 1                          # newest only
+    assert mine[0].verdict == "regression"
+    assert mine[0].basis == "absolute-bound"
+
+
+def test_committed_history_trace_overhead_within_budget():
+    """The recorded PERF_HISTORY.jsonl measurement passes its own gate —
+    the acceptance loop `perfwatch check` runs on every checkout."""
+    from mpi_blockchain_tpu.perfwatch.detector import check_history
+    from mpi_blockchain_tpu.perfwatch.history import (DEFAULT_HISTORY_NAME,
+                                                      HistoryStore)
+
+    store = HistoryStore(REPO / DEFAULT_HISTORY_NAME)
+    mine = [f for f in check_history(store)
+            if f.section == "trace_overhead"]
+    assert mine, "no trace_overhead entry recorded in PERF_HISTORY.jsonl"
+    assert all(f.verdict == "ok" for f in mine)
+
+
+def test_measure_block_observe_payload_and_isolation():
+    """The per-block observation audit: payload shape, kill-switch
+    restore, and no leakage into the real profiler ring or the live
+    block_critical_path_ms series (audit-labeled isolation)."""
+    from mpi_blockchain_tpu.blocktrace.overhead import measure_block_observe
+
+    payload = measure_block_observe(samples=16, chunk_pow2=8)
+    assert payload["backend"] == "cpu"
+    assert payload["samples"] == 16
+    assert payload["block_observe_us"] > 0
+    assert payload["p90_us"] >= payload["block_observe_us"]
+    assert not telemetry_disabled()
+    assert profiler().records() == []
+    assert telemetry.histogram("block_trace_gap_pct").count == 0
+    # the audit's own samples land only on the labeled series
+    audit = telemetry.histogram("block_trace_gap_pct",
+                                backend="trace-audit")
+    assert audit.count == 16
+
+
+def test_block_observe_gated_by_absolute_bound(tmp_path):
+    from mpi_blockchain_tpu.perfwatch.detector import check_candidate
+    from mpi_blockchain_tpu.perfwatch.history import HistoryStore
+
+    store = HistoryStore(tmp_path / "hist.jsonl")   # empty: no baseline
+    over = check_candidate(store, "trace_block_observe",
+                           {"block_observe_us": 450.0, "backend": "cpu"})
+    assert over.verdict == "regression" and over.basis == "absolute-bound"
+    ok = check_candidate(store, "trace_block_observe",
+                         {"block_observe_us": 90.0, "backend": "cpu"})
+    assert ok.verdict == "ok"
+
+
+def test_committed_history_block_observe_within_budget():
+    from mpi_blockchain_tpu.perfwatch.detector import check_history
+    from mpi_blockchain_tpu.perfwatch.history import (DEFAULT_HISTORY_NAME,
+                                                      HistoryStore)
+
+    store = HistoryStore(REPO / DEFAULT_HISTORY_NAME)
+    mine = [f for f in check_history(store)
+            if f.section == "trace_block_observe"]
+    assert mine, ("no trace_block_observe entry recorded in "
+                  "PERF_HISTORY.jsonl")
+    assert all(f.verdict == "ok" for f in mine)
+
+
+# ---- detector verdict rendering (satellite: auditable text) -------------
+
+
+def test_relative_verdict_render_carries_delta_and_basis(tmp_path):
+    from mpi_blockchain_tpu.perfwatch.detector import check_history
+    from mpi_blockchain_tpu.perfwatch.history import HistoryStore
+
+    store = HistoryStore(tmp_path / "hist.jsonl")
+    base = {"kernel": "pallas", "batch_pow2": 28, "n_miners": 1,
+            "spread_pct": 0.5, "reps": 2}
+    store.record("sweep", {**base, "hashes_per_sec_per_chip": 970e6},
+                 source="test")
+    store.record("sweep", {**base, "hashes_per_sec_per_chip": 940e6},
+                 source="test")
+    finding = check_history(store)[0]
+    text = finding.render()
+    assert finding.basis == "threshold"
+    assert "delta" in text and "allowed 10.0%" in text
+    assert "[threshold]" in text
+    assert "baseline" in text
+
+
+def test_spread_basis_named_when_spread_wins(tmp_path):
+    from mpi_blockchain_tpu.perfwatch.detector import check_history
+    from mpi_blockchain_tpu.perfwatch.history import HistoryStore
+
+    store = HistoryStore(tmp_path / "hist.jsonl")
+    base = {"kernel": "pallas", "batch_pow2": 28, "n_miners": 1,
+            "spread_pct": 9.0, "reps": 2}
+    store.record("sweep", {**base, "hashes_per_sec_per_chip": 970e6},
+                 source="test")
+    store.record("sweep", {**base, "hashes_per_sec_per_chip": 880e6},
+                 source="test")
+    finding = check_history(store)[0]
+    assert finding.basis == "spread"               # 2*9% > 10% threshold
+    assert "[spread]" in finding.render()
+
+
+# ---- miner + fused integration ------------------------------------------
+
+
+def test_miner_blocks_fully_attributed_and_metered():
+    from mpi_blockchain_tpu.config import MinerConfig
+    from mpi_blockchain_tpu.models.miner import Miner
+
+    m = Miner(MinerConfig(difficulty_bits=8, n_blocks=3, backend="cpu"))
+    m.mine_chain()
+    report = critical_path_report(profiler().records())
+    assert report["heights"] == [1, 2, 3]
+    assert report["unattributed_segments"] == 0
+    for h in report["heights"]:
+        b = report["blocks"][str(h)]
+        assert_conserved(b)
+        assert b["complete"], (h, b["gap_pct"], b["critical_path"])
+        assert "device" in b["stages_ms"]
+    assert telemetry.histogram("block_trace_gap_pct").count == 3
+    assert telemetry.histogram("block_latency_ms", backend="cpu").count == 3
+
+
+def test_fused_drain_stamps_block_latency_and_traces():
+    """Satellite: the fused loop's block_latency_ms twin
+    (backend="tpu-fused", batch wall amortized over k) + per-block
+    attribution through the estimated device split."""
+    from mpi_blockchain_tpu.config import MinerConfig
+    from mpi_blockchain_tpu.models.fused import FusedMiner
+
+    cfg = MinerConfig(difficulty_bits=8, n_blocks=4, batch_pow2=10,
+                      backend="tpu", kernel="jnp")
+    fm = FusedMiner(cfg, blocks_per_call=2)
+    fm.mine_chain()
+    lat = telemetry.histogram("block_latency_ms", backend="tpu-fused")
+    assert lat.count == 4                      # one stamp per block
+    sample = lat.snapshot()
+    assert sample["min"] > 0
+    report = critical_path_report(profiler().records())
+    assert report["heights"] == [1, 2, 3, 4]
+    for h in report["heights"]:
+        b = report["blocks"][str(h)]
+        assert_conserved(b)
+        # drain-side validate/append carry exact per-block stamps
+        assert "append" in b["stages_ms"] or "validate" in b["stages_ms"]
+    assert telemetry.histogram("block_trace_gap_pct").count == 4
+
+
+# ---- the perfwatch critical-path CLI ------------------------------------
+
+
+def _write_shard(directory, rank, records):
+    directory.mkdir(parents=True, exist_ok=True)
+    (directory / f"rank_{rank:04d}.json").write_text(json.dumps(
+        {"version": 1, "rank": rank, "world_size": 2,
+         "pipeline": records}))
+
+
+def test_cli_critical_path_mesh_dir_json_and_trace(tmp_path, capsys):
+    from mpi_blockchain_tpu.perfwatch.__main__ import main
+
+    mesh = tmp_path / "mesh"
+    _write_shard(mesh, 0, [rec(rank=0, meta={"height": 1},
+                               segments=[seg("device", 100.0, 100.010),
+                                         seg("append", 100.010,
+                                             100.011)])])
+    _write_shard(mesh, 1, [rec(rank=1, meta={"height": 1},
+                               segments=[seg("device", 100.0,
+                                             100.020)])])
+    trace_out = tmp_path / "trace.json"
+    rc = main(["critical-path", "--mesh-dir", str(mesh), "--json",
+               "--trace", str(trace_out)])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["event"] == "perfwatch_critical_path"
+    block = out["blocks"]["1"]
+    assert set(block["ranks"]) == {"0", "1"}
+    assert block["critical_rank"] == 1
+    trace = json.loads(trace_out.read_text())
+    assert any(e.get("pid") == CRITICAL_PID for e in trace["traceEvents"])
+    assert out["trace"]["events"] == len(trace["traceEvents"])
+
+
+def test_cli_critical_path_text_and_missing_height(tmp_path, capsys):
+    from mpi_blockchain_tpu.perfwatch.__main__ import main
+
+    mesh = tmp_path / "mesh"
+    _write_shard(mesh, 0, [rec(rank=0, meta={"height": 2},
+                               segments=[seg("device", 0.0, 0.010)])])
+    assert main(["critical-path", "--mesh-dir", str(mesh)]) == 0
+    assert "block 2" in capsys.readouterr().out
+    assert main(["critical-path", "--mesh-dir", str(mesh),
+                 "--height", "9"]) == 1
+
+
+def test_cli_critical_path_in_process_profiler(capsys):
+    from mpi_blockchain_tpu.perfwatch.__main__ import main
+
+    with trace_block(4):
+        prec = profiler().dispatch(kind="sweep", backend="cpu")
+        with prec.segment("device"):
+            pass
+        with prec.segment("append"):
+            pass
+    assert main(["critical-path", "--height", "4", "--json"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["source"] == "in-process"
+    assert out["heights"] == [4]
