@@ -31,8 +31,19 @@ classic *drift* bugs at analysis time, before any run launches:
   skippable through a swallowing ``try`` (SPMD0xx rules).
 * ``hotpath_lint`` — blocking calls reachable on the dispatch hot path
   outside the sanctioned async seams (HOT0xx rules).
+* ``sync_lint`` — device-sync discipline on the same hot path: a
+  value-provenance pass tags device-origin values (backend ``search``
+  results, dispatched device programs, ``jnp.*``) and flags implicit
+  host syncs and device values escaping into Python control flow
+  outside the sanctioned materialization seam (SYNC0xx rules).
+* ``donation_lint`` — buffer-donation correctness: use-after-donate,
+  sweep-shaped dispatches threading an undonated buffer, donation of
+  live host state (DON0xx rules).
 * ``opbudget`` — the jaxpr op-budget ratchet: the kernel's static ALU
   census must not exceed the committed ``OPBUDGET.json`` (OPB0xx rules).
+* ``transfer_budget`` — the device-transfer ratchet: the sweep path's
+  static transfer/sync-site census must not exceed the committed
+  ``TRANSFERBUDGET.json`` (TRB0xx rules).
 
 CLI: ``python -m mpi_blockchain_tpu.analysis`` — exits non-zero on any
 finding. Findings are emitted in a deterministic (file, line, rule)
@@ -126,6 +137,33 @@ def rel_path(path: pathlib.Path, root: pathlib.Path) -> str:
             else str(path))
 
 
+def package_scope(root: pathlib.Path, subdirs: Iterable[str] = (),
+                  extras: Iterable[str] = (),
+                  core_glob: bool = False) -> list[pathlib.Path]:
+    """Default scope-file builder shared by the file-scoped passes:
+    rglob of package subdirs + optional ``core/*.py`` glob (top level
+    only — core/src is C++) + explicit package-relative extras, sorted.
+    One copy, so a sweep-path refactor updates every family's scope in
+    its pass module's argument list rather than three hand-rolled
+    walkers."""
+    pkg = root / REPO_PACKAGE
+    files: list[pathlib.Path] = []
+    for sub in subdirs:
+        d = pkg / sub
+        if d.is_dir():
+            files += [p for p in d.rglob("*.py")
+                      if "__pycache__" not in p.parts]
+    if core_glob:
+        core = pkg / "core"
+        if core.is_dir():
+            files += list(core.glob("*.py"))
+    for extra in extras:
+        p = pkg / extra
+        if p.is_file():
+            files.append(p)
+    return sorted(files)
+
+
 def override_files(overrides: dict | None, key: str,
                    default: Callable[[], Iterable[pathlib.Path]]
                    ) -> list[pathlib.Path]:
@@ -145,6 +183,7 @@ def pass_families() -> dict[str, Callable[..., list[Finding]]]:
     syntax error in one pass does not take down the others' rule docs)."""
     from .binding_contract import run_binding_contract
     from .conc_lint import run_conc_lint
+    from .donation_lint import run_donation_lint
     from .header_layout import run_header_layout
     from .hotpath_lint import run_hotpath_lint
     from .jax_lint import run_jax_lint
@@ -152,7 +191,9 @@ def pass_families() -> dict[str, Callable[..., list[Finding]]]:
     from .resilience_lint import run_resilience_lint
     from .sanitizers import run_sanitizers
     from .spmd_lint import run_spmd_lint
+    from .sync_lint import run_sync_lint
     from .telemetry_lint import run_telemetry_lint
+    from .transfer_budget import run_transfer_budget
     return {
         "binding": run_binding_contract,
         "header": run_header_layout,
@@ -163,7 +204,10 @@ def pass_families() -> dict[str, Callable[..., list[Finding]]]:
         "conc": run_conc_lint,
         "spmd": run_spmd_lint,
         "hotpath": run_hotpath_lint,
+        "sync": run_sync_lint,
+        "don": run_donation_lint,
         "opbudget": run_opbudget,
+        "trb": run_transfer_budget,
     }
 
 
@@ -184,16 +228,30 @@ FAMILY_SCOPES: dict[str, tuple[str, ...]] = {
     "spmd": ("mpi_blockchain_tpu/parallel", "experiments",
              "mpi_blockchain_tpu/resilience/elastic.py"),
     "hotpath": ("mpi_blockchain_tpu",),
+    "sync": ("mpi_blockchain_tpu/models", "mpi_blockchain_tpu/backend",
+             "mpi_blockchain_tpu/parallel", "mpi_blockchain_tpu/core",
+             "mpi_blockchain_tpu/utils", "mpi_blockchain_tpu/config.py",
+             "mpi_blockchain_tpu/resilience/dispatch.py",
+             "mpi_blockchain_tpu/resilience/elastic.py"),
+    "don": ("mpi_blockchain_tpu/models", "mpi_blockchain_tpu/backend",
+            "mpi_blockchain_tpu/parallel",
+            "mpi_blockchain_tpu/resilience/dispatch.py",
+            "mpi_blockchain_tpu/resilience/elastic.py"),
     "opbudget": ("mpi_blockchain_tpu/ops", "OPBUDGET.json",
                  "experiments/roofline.py",
                  "mpi_blockchain_tpu/analysis/opbudget.py"),
+    "trb": ("mpi_blockchain_tpu/models", "mpi_blockchain_tpu/backend",
+            "mpi_blockchain_tpu/parallel",
+            "mpi_blockchain_tpu/resilience/dispatch.py",
+            "TRANSFERBUDGET.json"),
 }
 
 #: Rule-id prefix -> owning family (suppression audit attribution).
 RULE_FAMILIES = {"BIND": "binding", "HDR": "header", "JAX": "jax",
                  "SAN": "sanitizers", "TEL": "telemetry",
                  "RES": "resilience", "CONC": "conc", "SPMD": "spmd",
-                 "HOT": "hotpath", "OPB": "opbudget"}
+                 "HOT": "hotpath", "SYNC": "sync", "DON": "don",
+                 "OPB": "opbudget", "TRB": "trb"}
 
 
 #: A change under the analysis engine itself (a pass module, the

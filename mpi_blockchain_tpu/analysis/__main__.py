@@ -14,6 +14,10 @@ Modes beyond the default lint run:
   families whose scope holds a changed file run (``make lint-fast``).
 * ``--rebaseline`` — write the current static ALU census into
   OPBUDGET.json; refuses to raise the budget (the ratchet).
+* ``--rebaseline-transfers`` — the same ratchet for the device-transfer
+  census into TRANSFERBUDGET.json; a justified RAISE of either budget
+  goes through its sanctioned mover (``roofline.py --write-budget`` /
+  ``python -m mpi_blockchain_tpu.analysis.transfer_budget --write``).
 * ``--jobs N`` — run pass families on a thread pool; per-pass wall
   times are always collected and emitted under ``pass_timings_ms`` in
   ``--json`` output (which is a JSON object: ``{"findings": [...],
@@ -37,7 +41,9 @@ OVERRIDE_KEYS = ("capi", "ctypes_binding", "pybind", "chain_hpp",
                  "adversary_files", "rank_scope_files",
                  "blocktrace_scope_files", "jax_files",
                  "conc_files", "spmd_files", "elastic_files",
-                 "hotpath_files", "opbudget_json", "kernel_src")
+                 "hotpath_files", "opbudget_json", "kernel_src",
+                 "sync_files", "donation_files",
+                 "transferbudget_json", "transfer_files")
 
 
 def _changed_files(root: pathlib.Path, rev: str) -> list[str] | None:
@@ -69,7 +75,9 @@ def main(argv: list[str] | None = None) -> int:
         description="chainlint: cross-language static analysis "
                     "(binding contract, header layout, JAX purity, "
                     "sanitizer matrix, thread races, SPMD collectives, "
-                    "hot-path blocking, op-budget ratchet)")
+                    "hot-path blocking, device-sync provenance, "
+                    "buffer donation, op-budget + transfer-budget "
+                    "ratchets)")
     parser.add_argument("--root", type=pathlib.Path, default=None,
                         help="repo root (default: auto-detected)")
     parser.add_argument("--passes", default=None,
@@ -96,6 +104,10 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--rebaseline", action="store_true",
                         help="write the current static ALU census into "
                              "OPBUDGET.json (refuses to raise it)")
+    parser.add_argument("--rebaseline-transfers", action="store_true",
+                        help="write the current static transfer-site "
+                             "census into TRANSFERBUDGET.json (refuses "
+                             "to raise it)")
     parser.add_argument("-q", "--quiet", action="store_true",
                         help="suppress the summary/notes lines")
     args = parser.parse_args(argv)
@@ -118,6 +130,18 @@ def main(argv: list[str] | None = None) -> int:
             print(f"chainlint: rebaseline refused: {e}", file=sys.stderr)
             return 2
         print(f"chainlint: op budget rebaselined {old} -> {new} "
+              f"({path})", file=sys.stderr)
+        return 0
+
+    if args.rebaseline_transfers:
+        from .transfer_budget import rebaseline_transfers
+        try:
+            old, new, path = rebaseline_transfers(root, overrides)
+        except (ValueError, OSError) as e:
+            print(f"chainlint: rebaseline-transfers refused: {e}",
+                  file=sys.stderr)
+            return 2
+        print(f"chainlint: transfer budget rebaselined {old} -> {new} "
               f"({path})", file=sys.stderr)
         return 0
 

@@ -175,6 +175,51 @@ class CallGraph:
 
     # ---- traversal -------------------------------------------------------
 
+    def resolve_roots(self, entry_points: Iterable[tuple[str, str]]
+                      ) -> tuple[list["FuncInfo"], list[tuple[str, str]]]:
+        """Resolves ``(class, method)`` entry points to their FuncInfos:
+        ``(roots, missing)``. The one copy of the root-set lookup every
+        hot-path-rooted pass (HOTPATH, SYNC) shares — a missing entry is
+        the pass's "the lint is checking nothing" rule (HOT002/SYNC003),
+        reported per pass so a ``--passes`` subset still fires it."""
+        roots: list[FuncInfo] = []
+        missing: list[tuple[str, str]] = []
+        for cls, method in entry_points:
+            matches = [f for f in self.functions.values()
+                       if f.cls == cls and f.name == method]
+            if matches:
+                roots.extend(matches)
+            else:
+                missing.append((cls, method))
+        return roots, missing
+
+    def nested_parents(self) -> dict[str, str]:
+        """{nested function qual: qual of its NEAREST enclosing analyzed
+        function} for every closure/thread-body def. Passes that analyze
+        whole function bodies inline (the provenance walk) use this to
+        skip a nested def only when an ancestor is itself analyzed —
+        a reachable closure whose enclosing function is NOT reachable
+        still gets its own standalone walk. The walk switches parent at
+        every function boundary, so starting from any ancestor yields
+        the same nearest-parent answer."""
+        by_node = {id(info.node): info.qual
+                   for info in self.functions.values()}
+        parents: dict[str, str] = {}
+
+        def visit(node: ast.AST, parent_qual: str) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, _FUNC_NODES):
+                    qual = by_node.get(id(child))
+                    if qual is not None:
+                        parents[qual] = parent_qual
+                        visit(child, qual)
+                        continue
+                visit(child, parent_qual)
+
+        for info in self.functions.values():
+            visit(info.node, info.qual)
+        return parents
+
     def reachable(self, roots: Iterable[FuncInfo],
                   prune: Callable[[FuncInfo], bool] | None = None
                   ) -> dict[str, list[str]]:

@@ -1,0 +1,306 @@
+"""DON rules — buffer-donation correctness for the device dispatch path.
+
+The async-pipeline refactor (ROADMAP item 1) dispatches sweep N+1 with
+DONATED buffers while the host drains sweep N — donation is what makes
+the double-buffer handoff zero-copy. Donation bugs are silent on CPU
+(XLA quietly copies instead) and catastrophic on TPU: a donated buffer
+read after the call returns garbage, and a forgotten donation doubles
+HBM pressure exactly where the pipeline needs it least. Nothing dynamic
+tests this before a TPU run, so it is linted statically:
+
+  DON001  use-after-donate — a local value passed in a
+          ``donate_argnums`` position of a jit'd callable is read again
+          after the call (before any rebind). The donated buffer's
+          storage belongs to the device after dispatch; the later read
+          sees garbage (or, on backends that copy, hides a perf bug
+          that detonates on TPU).
+  DON002  a sweep-shaped dispatch with no donation declared: a built
+          device program (the ``self._fn(k)(...)``/factory-call shape,
+          or a module-local jit'd name) whose call THREADS a buffer —
+          the same name appears as an argument and as an assignment
+          target of the result (``nonces, prev = fn(prev, ...)``).
+          That is the double-buffer pipeline shape; the threaded
+          buffer must be donated (``donate_argnums``/``donate=...``)
+          or the dispatch pays a device-side copy per sweep.
+  DON003  donation declared on an argument that aliases live host
+          state — an attribute (``self.buf``) or module-global passed
+          in a donated position. The host alias outlives the call, and
+          any later read through it is DON001 invisible to a
+          per-function pass; donate only call-local buffers.
+
+Declarations are tracked module-locally: ``fn = jax.jit(body,
+donate_argnums=(0,))``, decorator forms (``@jax.jit(...)`` /
+``@functools.partial(jax.jit, donate_argnums=...)``), and
+``functools.partial`` nesting. Cross-module declaration/call pairs are
+out of scope (the call-graph builder's known limits); DON002's
+factory-call shape is the deliberate catch-all for dispatches whose jit
+wrapper lives elsewhere — a site that genuinely donates can carry a
+``donate``/``donate_argnums`` keyword or a justified inline
+suppression.
+
+Scope (override key ``donation_files``): ``models/``, ``backend/``,
+``parallel/``, ``resilience/dispatch.py``, ``resilience/elastic.py``.
+"""
+from __future__ import annotations
+
+import ast
+import pathlib
+
+from . import Finding, override_files, package_scope, rel_path
+from .callgraph import call_name, dotted
+from .sync_lint import DEVICE_FACTORIES, _FACTORY_PREFIXES
+
+_JIT_NAMES = ("jax.jit", "jit", "pjit", "jax.pjit")
+
+
+def _donate_positions(call: ast.Call) -> set[int] | None:
+    """The literal donate_argnums positions of a jit(...) call; an
+    EMPTY set when donation is declared but positions are not literal
+    ints (donate_argnames, a computed tuple) — still a declaration, so
+    DON002 must honor it even though DON001/DON003 cannot resolve the
+    positions; None when the call declares no donation at all."""
+    for kw in call.keywords:
+        if kw.arg in ("donate_argnums", "donate_argnames"):
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return {v.value}
+            if isinstance(v, (ast.Tuple, ast.List)):
+                return {e.value for e in v.elts
+                        if isinstance(e, ast.Constant)
+                        and isinstance(e.value, int)}
+            return set()     # non-positional: declared, positions unknown
+    return None
+
+
+def _jit_donations(expr: ast.expr) -> set[int] | None:
+    """Donated positions when ``expr`` is a jit wrapper (possibly under
+    functools.partial nesting); None when it is not a jit wrapper or
+    declares no donation."""
+    if not isinstance(expr, ast.Call):
+        return None
+    d = dotted(expr.func)
+    if d in _JIT_NAMES:
+        return _donate_positions(expr)
+    if d in ("functools.partial", "partial") and expr.args:
+        inner = _jit_donations(expr.args[0])
+        mine = _donate_positions(expr)
+        if inner is None and mine is None:
+            return None
+        return (inner or set()) | (mine or set())
+    return None
+
+
+def _collect_donated(tree: ast.Module) -> dict[str, set[int]]:
+    """{callable name: donated positions} declared module-locally."""
+    donated: dict[str, set[int]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            pos = _jit_donations(node.value)
+            if pos is not None:
+                donated[node.targets[0].id] = pos
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for deco in node.decorator_list:
+                pos = _jit_donations(deco) if isinstance(deco, ast.Call) \
+                    else None
+                if pos is not None:
+                    donated[node.name] = pos
+    return donated
+
+
+def _name_events(fn: ast.AST, name: str) -> list[tuple[int, bool]]:
+    """Sorted (lineno, is_store) events for ``name`` in a function."""
+    events: list[tuple[int, bool]] = []
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and node.id == name:
+            events.append((node.lineno,
+                           isinstance(node.ctx, (ast.Store, ast.Del))))
+    return sorted(events)
+
+
+def _is_dispatch_call(node: ast.Call,
+                      jit_names: dict[str, set[int]]) -> bool:
+    """A call that dispatches a built device program (DON002 subject)."""
+    if isinstance(node.func, ast.Call):
+        inner = call_name(node.func)
+        return inner in DEVICE_FACTORIES or \
+            any(inner.startswith(p) for p in _FACTORY_PREFIXES)
+    return call_name(node) in jit_names
+
+
+def _site_declares_donation(node: ast.Call) -> bool:
+    keys = {kw.arg for kw in node.keywords}
+    if {"donate", "donate_argnums", "donate_argnames"} & keys:
+        return True
+    if isinstance(node.func, ast.Call):
+        inner_keys = {kw.arg for kw in node.func.keywords}
+        return bool({"donate", "donate_argnums", "donate_argnames"}
+                    & inner_keys)
+    return False
+
+
+class _FnChecker:
+    """Per-function DON checks (nested defs are walked with the
+    enclosing function — the closure dispatch idiom)."""
+
+    def __init__(self, rel: str, fn: ast.AST,
+                 donated: dict[str, set[int]],
+                 jit_names: dict[str, set[int]],
+                 globals_: set[str], findings: list[Finding]):
+        self.rel = rel
+        self.fn = fn
+        self.donated = donated
+        self.jit_names = jit_names
+        self.globals_ = globals_
+        self.findings = findings
+
+    def check(self) -> None:
+        for node in ast.walk(self.fn):
+            if isinstance(node, ast.Call):
+                self._check_donated_site(node)
+            elif isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call):
+                self._check_threading(node)
+
+    # -- DON001 / DON003 ---------------------------------------------------
+
+    def _check_donated_site(self, node: ast.Call) -> None:
+        positions = self.donated.get(call_name(node))
+        if not positions or not isinstance(node.func,
+                                           (ast.Name, ast.Attribute)):
+            return
+        for pos in sorted(positions):
+            if pos >= len(node.args):
+                continue
+            arg = node.args[pos]
+            if isinstance(arg, ast.Attribute) or (
+                    isinstance(arg, ast.Name) and arg.id in self.globals_):
+                label = dotted(arg) or call_name(node)
+                self.findings.append(Finding(
+                    self.rel, arg.lineno, "DON003",
+                    f"donated argument {pos} of '{call_name(node)}' is "
+                    f"'{label}', which aliases live host state — the "
+                    f"alias outlives the dispatch and any later read "
+                    f"through it sees a donated (garbage) buffer; "
+                    f"donate only call-local buffers, or drop the "
+                    f"donation for this argument"))
+            elif isinstance(arg, ast.Name):
+                self._check_use_after(node, pos, arg)
+
+    def _check_use_after(self, call: ast.Call, pos: int,
+                         arg: ast.Name) -> None:
+        # The call's whole source extent counts as the call: a multiline
+        # argument list must not read as a "later" load of its own arg.
+        call_end = getattr(call, "end_lineno", None) or call.lineno
+        for lineno, is_store in _name_events(self.fn, arg.id):
+            if call.lineno <= lineno <= call_end and is_store:
+                return          # `buf = fn(buf, ...)`: rebound from the
+                #                 call's own output — the donation idiom
+            if lineno <= call_end:
+                continue
+            if is_store:
+                return          # rebound before any later read
+            self.findings.append(Finding(
+                self.rel, lineno, "DON001",
+                f"'{arg.id}' is read here after being donated to "
+                f"'{call_name(call)}' on line {call.lineno} "
+                f"(donate_argnums position {pos}) — the buffer's "
+                f"storage belongs to the device after dispatch and "
+                f"this read sees garbage; rebind the name from the "
+                f"call's outputs, or drop the donation"))
+            return              # one finding per donation site
+
+    # -- DON002 ------------------------------------------------------------
+
+    def _check_threading(self, node: ast.Assign) -> None:
+        call = node.value
+        if not _is_dispatch_call(call, self.jit_names):
+            return
+        if _site_declares_donation(call):
+            return
+        name = call_name(call)
+        # Any module-local donation declaration counts — including
+        # donate_argnames / computed positions (empty position set).
+        if name in self.donated:
+            return
+        targets: set[str] = set()
+        for t in node.targets:
+            for n in ast.walk(t):
+                if isinstance(n, ast.Name) and \
+                        isinstance(n.ctx, ast.Store):
+                    targets.add(n.id)
+        arg_names = {a.id for a in call.args if isinstance(a, ast.Name)}
+        threaded = sorted(targets & arg_names)
+        if threaded:
+            self.findings.append(Finding(
+                self.rel, node.lineno, "DON002",
+                f"sweep-shaped dispatch threads "
+                f"{', '.join(repr(t) for t in threaded)} through the "
+                f"device call with no donation declared — the "
+                f"double-buffer pipeline shape pays a device-side copy "
+                f"per dispatch without donate_argnums; declare the "
+                f"donation on the jit wrapper (or a donate= keyword at "
+                f"the site), or suppress with a written justification "
+                f"(docs/static_analysis.md §DON)"))
+
+
+def _module_globals(tree: ast.Module) -> set[str]:
+    names: set[str] = set()
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    names.add(t.id)
+        elif isinstance(node, ast.AnnAssign) and \
+                isinstance(node.target, ast.Name):
+            names.add(node.target.id)
+    return names
+
+
+def _scoped_files(root: pathlib.Path) -> list[pathlib.Path]:
+    return package_scope(
+        root, subdirs=("models", "backend", "parallel"),
+        extras=("resilience/dispatch.py", "resilience/elastic.py"))
+
+
+def run_donation_lint(root: pathlib.Path, overrides=None,
+                      notes=None) -> list[Finding]:
+    files = override_files(overrides, "donation_files",
+                           lambda: _scoped_files(root))
+    findings: list[Finding] = []
+    for path in files:
+        path = pathlib.Path(path)
+        rel = rel_path(path, root)
+        try:
+            tree = ast.parse(path.read_text(), filename=str(path))
+        except SyntaxError as e:
+            findings.append(Finding(rel, e.lineno or 1, "DON000",
+                                    f"syntax error: {e.msg}"))
+            continue
+        except OSError:
+            continue
+        donated = _collect_donated(tree)
+        jit_names = dict(donated)
+        # jit'd names with NO donation also participate in DON002.
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and isinstance(node.value, ast.Call) \
+                    and dotted(node.value.func) in _JIT_NAMES:
+                jit_names.setdefault(node.targets[0].id, set())
+        globals_ = _module_globals(tree)
+
+        # Outermost functions only: the checker walks each function's
+        # whole subtree, so nested defs (dispatch closures) are covered
+        # by their enclosing function's walk and never re-visited —
+        # visit() stops recursing at the first function boundary.
+        def visit(node: ast.AST) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    _FnChecker(rel, child, donated, jit_names,
+                               globals_, findings).check()
+                else:
+                    visit(child)
+        visit(tree)
+    return findings

@@ -54,10 +54,12 @@ from __future__ import annotations
 import ast
 import pathlib
 
-from . import Finding, override_files, rel_path
+from . import Finding, override_files, package_scope, rel_path
 from .callgraph import CallGraph, FuncInfo, call_name, dotted
 
 #: (class, method) hot-path entry points; every one must exist (HOT002).
+#: Shared root set: sync_lint walks the same roots (SYNC003 mirrors
+#: HOT002), so a rename is caught by whichever family runs.
 ENTRY_POINTS = (
     ("Miner", "mine_chain"),
     ("Miner", "mine_block"),
@@ -122,20 +124,10 @@ def _banned_label(node: ast.Call) -> str | None:
 
 
 def _scoped_files(root: pathlib.Path) -> list[pathlib.Path]:
-    pkg = root / "mpi_blockchain_tpu"
-    files: list[pathlib.Path] = []
-    for sub in ("models", "backend", "ops", "parallel", "utils"):
-        d = pkg / sub
-        if d.is_dir():
-            files += [p for p in d.rglob("*.py")
-                      if "__pycache__" not in p.parts]
-    core = pkg / "core"
-    if core.is_dir():
-        files += list(core.glob("*.py"))
-    for extra in (pkg / "config.py", pkg / "resilience" / "dispatch.py"):
-        if extra.is_file():
-            files.append(extra)
-    return sorted(files)
+    return package_scope(
+        root, subdirs=("models", "backend", "ops", "parallel", "utils"),
+        extras=("config.py", "resilience/dispatch.py"),
+        core_glob=True)
 
 
 def _is_sanctioned(info: FuncInfo) -> bool:
@@ -155,19 +147,14 @@ def run_hotpath_lint(root: pathlib.Path, overrides=None,
 
     anchor = (rel_path(files[0], root) if files
               else "mpi_blockchain_tpu")
-    roots: list[FuncInfo] = []
-    for cls, method in ENTRY_POINTS:
-        matches = [f for f in graph.functions.values()
-                   if f.cls == cls and f.name == method]
-        if matches:
-            roots.extend(matches)
-        else:
-            findings.append(Finding(
-                anchor, 1, "HOT002",
-                f"hot-path entry point {cls}.{method} not found in the "
-                f"analyzed file set — the blocking-call lint is "
-                f"checking nothing for it; update ENTRY_POINTS in "
-                f"analysis/hotpath_lint.py alongside the rename"))
+    roots, missing = graph.resolve_roots(ENTRY_POINTS)
+    for cls, method in missing:
+        findings.append(Finding(
+            anchor, 1, "HOT002",
+            f"hot-path entry point {cls}.{method} not found in the "
+            f"analyzed file set — the blocking-call lint is "
+            f"checking nothing for it; update ENTRY_POINTS in "
+            f"analysis/hotpath_lint.py alongside the rename"))
 
     chains = graph.reachable(roots, prune=_is_sanctioned)
     seen: set[tuple[str, int]] = set()

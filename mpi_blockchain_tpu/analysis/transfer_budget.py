@@ -1,0 +1,362 @@
+"""TRB rules — the device-transfer budget ratchet for the sweep path.
+
+OPBUDGET ratchets the kernel's per-nonce ALU work; nothing ratcheted the
+per-dispatch overhead around it — yet AsicBoost (arxiv 1604.00575) and
+the inner-for-loop paper (arxiv 1906.02770) both show dispatch-overhead
+discipline, not just ALU counts, deciding mining throughput, and the
+round-4 redesign's entire win was deleting host<->device round trips.
+This pass is the tripwire that keeps them deleted: a committed baseline
+(``TRANSFERBUDGET.json``) pins a **static transfer-site census** — a
+deterministic count of host<->device transfer/sync call sites in the
+sweep-path sources — and the build fails when the census grows.
+
+The static census counts, per scoped file:
+
+* ``np.asarray``/``np.array`` (D2H materialization; the jnp spellings
+  are device-side constructors and are NOT transfers),
+* ``jax.device_put``/``device_get``,
+* ``.block_until_ready()``/``.copy_to_host_async()``/
+  ``.addressable_data()``/``.item()``/``.tolist()``,
+* calls to the sanctioned seam itself
+  (``replicated_host_value``/``replicated_host_values``) — adding a new
+  seam call site IS adding a transfer, and must show up in a reviewed
+  baseline diff.
+
+Like OPBUDGET's static ALU census it is a monotone *proxy*: any edit
+that adds a transfer/sync site raises it, which is all a ratchet needs.
+The physically-meaningful numbers ride along in the baseline's
+``traced`` section: the one sanctioned mover —
+``python -m mpi_blockchain_tpu.analysis.transfer_budget --write``
+(imports jax; this gate pass never does) — traces the sweep callables
+per backend flavor (the multi-round searcher, the fused k-block miner)
+and censuses actual transfer/sync primitives in the jaxpr:
+``device_put`` equations, host callbacks, and ``convert_element_type``
+*widenings* (an unexpected widening doubles the bytes every transfer
+moves).
+
+  TRB001  the static transfer-site census exceeds the committed budget
+          — transfers on the sweep path only ratchet DOWN. A justified
+          increase goes through the sanctioned mover and a reviewed
+          TRANSFERBUDGET.json diff; ``--rebaseline-transfers`` only
+          accepts a LOWER census.
+  TRB002  TRANSFERBUDGET.json is missing, unparseable, or lacks the
+          required keys — the transfer ratchet is not armed.
+  TRB003  the census scope resolves to no readable source files — the
+          gate is counting nothing (fires when a refactor moves the
+          sweep files without updating SWEEP_SCOPE here).
+
+Override keys: ``transferbudget_json`` (baseline path),
+``transfer_files`` (census file set) — the drift-fixture seams.
+"""
+from __future__ import annotations
+
+import ast
+import json
+import pathlib
+
+from . import Finding, override_files, rel_path
+from .callgraph import call_name, dotted
+
+BASELINE_NAME = "TRANSFERBUDGET.json"
+REQUIRED_KEYS = ("static_transfer_sites", "traced")
+
+#: The sweep-path sources whose transfer sites are budgeted (the files
+#: between the mine-loop entry points and the device program).
+SWEEP_SCOPE = (
+    "mpi_blockchain_tpu/models/miner.py",
+    "mpi_blockchain_tpu/models/fused.py",
+    "mpi_blockchain_tpu/backend/tpu.py",
+    "mpi_blockchain_tpu/backend/cpu.py",
+    "mpi_blockchain_tpu/parallel/mesh.py",
+    "mpi_blockchain_tpu/resilience/dispatch.py",
+)
+
+_NP_TRANSFER_DOTTED = {"np.asarray", "np.array", "numpy.asarray",
+                       "numpy.array"}
+_TRANSFER_NAMES = {"device_put", "device_get"}
+_TRANSFER_METHODS = {"block_until_ready", "copy_to_host_async",
+                     "addressable_data", "item", "tolist"}
+_SEAM_CALLS = {"replicated_host_value", "replicated_host_values"}
+
+
+def _site_label(node: ast.Call) -> str | None:
+    """The census label when this call is a transfer/sync site."""
+    name = call_name(node)
+    d = dotted(node.func)
+    if d in _NP_TRANSFER_DOTTED:
+        return d
+    if name in _TRANSFER_NAMES:
+        return d or name
+    if isinstance(node.func, ast.Attribute) and name in _TRANSFER_METHODS:
+        return f".{name}()"
+    if name in _SEAM_CALLS:
+        return name
+    return None
+
+
+def static_transfer_census(
+        root: pathlib.Path, files: list[pathlib.Path]
+) -> tuple[int, dict[str, int], list[tuple[str, int, str]],
+           tuple[str, int] | None]:
+    """(total, per-label counts, [(rel, line, syntax msg)], first site)
+    over the scoped files. ``first site`` anchors TRB001 at a
+    suppressible source line."""
+    total = 0
+    by_label: dict[str, int] = {}
+    errors: list[tuple[str, int, str]] = []
+    first: tuple[str, int] | None = None
+    for path in sorted(pathlib.Path(p) for p in files):
+        rel = rel_path(path, root)
+        try:
+            tree = ast.parse(path.read_text(), filename=str(path))
+        except SyntaxError as e:
+            errors.append((rel, e.lineno or 1, e.msg or "syntax error"))
+            continue
+        except OSError:
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            label = _site_label(node)
+            if label is None:
+                continue
+            total += 1
+            by_label[label] = by_label.get(label, 0) + 1
+            if first is None or (rel, node.lineno) < first:
+                first = (rel, node.lineno)
+    return total, by_label, errors, first
+
+
+def _paths(root: pathlib.Path, overrides: dict
+           ) -> tuple[pathlib.Path, list[pathlib.Path]]:
+    baseline = pathlib.Path(overrides.get("transferbudget_json",
+                                          root / BASELINE_NAME))
+    files = override_files(overrides, "transfer_files",
+                           lambda: [root / p for p in SWEEP_SCOPE])
+    return baseline, files
+
+
+def load_baseline(baseline: pathlib.Path) -> tuple[dict | None, str]:
+    """(budget dict, error message) — dict None iff invalid."""
+    try:
+        data = json.loads(baseline.read_text())
+    except OSError as e:
+        return None, f"cannot read {baseline.name}: {e}"
+    except ValueError as e:
+        return None, f"{baseline.name} is not valid JSON: {e}"
+    if not isinstance(data, dict):
+        return None, f"{baseline.name} must hold a JSON object"
+    sites = data.get("static_transfer_sites")
+    if not isinstance(sites, int) or isinstance(sites, bool) or sites < 0:
+        return None, (f"{baseline.name} lacks a non-negative integer "
+                      f"'static_transfer_sites' — regenerate it with "
+                      f"`python -m mpi_blockchain_tpu.analysis."
+                      f"transfer_budget --write`")
+    if not isinstance(data.get("traced"), dict):
+        return None, (f"{baseline.name} lacks the 'traced' per-flavor "
+                      f"jaxpr census — regenerate it with "
+                      f"`python -m mpi_blockchain_tpu.analysis."
+                      f"transfer_budget --write`")
+    return data, ""
+
+
+def run_transfer_budget(root: pathlib.Path, overrides=None,
+                        notes=None) -> list[Finding]:
+    overrides = overrides or {}
+    baseline_path, files = _paths(root, overrides)
+    baseline, err = load_baseline(baseline_path)
+    if baseline is None:
+        return [Finding(rel_path(baseline_path, root), 1, "TRB002",
+                        f"transfer-budget ratchet is not armed: {err}")]
+    readable = [p for p in files if pathlib.Path(p).is_file()]
+    if not readable:
+        return [Finding("mpi_blockchain_tpu", 1, "TRB003",
+                        "transfer-budget census scope resolves to no "
+                        "readable source file — the gate is counting "
+                        "nothing; update SWEEP_SCOPE in "
+                        "analysis/transfer_budget.py alongside the "
+                        "refactor")]
+    total, by_label, errors, first = static_transfer_census(root, readable)
+    findings = [Finding(rel, lineno, "TRB000", f"syntax error: {msg}")
+                for rel, lineno, msg in errors]
+    budget = baseline["static_transfer_sites"]
+    if total > budget:
+        anchor, line = first if first is not None else (
+            rel_path(pathlib.Path(readable[0]), root), 1)
+        breakdown = ", ".join(f"{k}×{v}" for k, v in sorted(by_label.items()))
+        findings.append(Finding(
+            anchor, line, "TRB001",
+            f"static transfer-site census grew: {total} > budget "
+            f"{budget} ({breakdown}). Host<->device transfers on the "
+            f"sweep path only ratchet DOWN (ROADMAP item 1 depends on "
+            f"it); if this increase is justified, re-census with "
+            f"`python -m mpi_blockchain_tpu.analysis.transfer_budget "
+            f"--write` and commit the TRANSFERBUDGET.json diff"))
+    elif total < budget and notes is not None:
+        notes.append(f"transfer_budget: static census {total} is below "
+                     f"the budget {budget} — ratchet it down with "
+                     f"--rebaseline-transfers (or the --write mover)")
+    return findings
+
+
+def rebaseline_transfers(root: pathlib.Path,
+                         overrides=None) -> tuple[int, int, pathlib.Path]:
+    """Writes the current static census into the baseline, refusing to
+    RAISE it (the ratchet). Returns (old, new, path). Raises ValueError
+    when the census is higher, the scope is empty, or there is no valid
+    baseline to amend — bootstrapping (and any justified raise) is the
+    sanctioned mover's job (``transfer_budget --write``, which records
+    the traced per-flavor census too)."""
+    overrides = overrides or {}
+    baseline_path, files = _paths(root, overrides)
+    readable = [p for p in files if pathlib.Path(p).is_file()]
+    if not readable:
+        raise ValueError("transfer census scope resolves to no readable "
+                         "source file — nothing to baseline")
+    total, by_label, errors, _ = static_transfer_census(root, readable)
+    if errors:
+        raise ValueError(f"census scope has syntax errors: {errors[0]}")
+    old_data, err = load_baseline(baseline_path)
+    if old_data is None:
+        raise ValueError(
+            f"no valid baseline to amend ({err}); bootstrap the budget "
+            f"with `python -m mpi_blockchain_tpu.analysis."
+            f"transfer_budget --write`")
+    old = old_data["static_transfer_sites"]
+    if total > old:
+        raise ValueError(
+            f"refusing to rebaseline upward: static transfer census "
+            f"{total} > committed budget {old}. Transfers only ratchet "
+            f"down; a justified increase must go through "
+            f"`python -m mpi_blockchain_tpu.analysis.transfer_budget "
+            f"--write` and a reviewed TRANSFERBUDGET.json diff")
+    data = dict(old_data)
+    data["static_transfer_sites"] = total
+    data["static_by_site"] = dict(sorted(by_label.items()))
+    # The scope list must describe the files the counts came from, or
+    # the committed review surface misstates the budget's coverage.
+    data["scope"] = [rel_path(pathlib.Path(p), root) for p in
+                     sorted(pathlib.Path(f) for f in readable)]
+    baseline_path.write_text(json.dumps(data, indent=1, sort_keys=True)
+                             + "\n")
+    return old, total, baseline_path
+
+
+# ---- the sanctioned mover (imports jax; never run by the gate) -------------
+
+
+def _count_jaxpr(jaxpr, counts: dict[str, int]) -> None:
+    """Recursive primitive census over a jaxpr and its subjaxprs."""
+    import numpy as np
+
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if name == "device_put":
+            counts["device_put"] += 1
+        elif "callback" in name:
+            counts["callbacks"] += 1
+        elif name == "convert_element_type":
+            try:
+                new = np.dtype(eqn.params["new_dtype"])
+                old = np.dtype(eqn.invars[0].aval.dtype)
+                if new.itemsize > old.itemsize:
+                    counts["convert_widenings"] += 1
+            except (KeyError, TypeError, AttributeError):
+                pass
+        for v in eqn.params.values():
+            for sub in (v if isinstance(v, (list, tuple)) else (v,)):
+                inner = getattr(sub, "jaxpr", None)
+                if inner is not None and hasattr(inner, "eqns"):
+                    _count_jaxpr(inner, counts)
+                elif hasattr(sub, "eqns"):
+                    _count_jaxpr(sub, counts)
+
+
+def trace_transfer_census() -> dict[str, dict[str, int]]:
+    """Traces the sweep callables per backend flavor and censuses
+    transfer/sync primitives in their jaxprs. Small shapes + the jnp
+    kernel: the transfer-primitive census is shape- and
+    platform-independent, and tracing never runs the program."""
+    import jax
+    import numpy as np
+
+    from ..backend.tpu import make_multiround_search_fn
+    from ..models.fused import make_fused_miner
+
+    flavors: dict[str, dict[str, int]] = {}
+
+    def census(fn, *args) -> dict[str, int]:
+        counts = {"device_put": 0, "callbacks": 0, "convert_widenings": 0}
+        closed = jax.make_jaxpr(fn)(*args)
+        _count_jaxpr(closed.jaxpr, counts)
+        counts["total_transfer_prims"] = (
+            counts["device_put"] + counts["callbacks"]
+            + counts["convert_widenings"])
+        return counts
+
+    u32 = np.uint32
+    multiround, _ = make_multiround_search_fn(
+        batch_size=1 << 8, difficulty_bits=12, kernel="jnp")
+    flavors["tpu_multiround"] = census(
+        multiround, np.zeros(8, u32), np.zeros(16, u32), u32(0), u32(4))
+    fused = make_fused_miner(k_blocks=2, batch_pow2=8, difficulty_bits=8,
+                             kernel="jnp")
+    flavors["fused"] = census(
+        fused, np.zeros(8, u32), np.zeros((2, 8), u32), u32(0))
+    return flavors
+
+
+def write_budget(root: pathlib.Path | None = None,
+                 overrides=None) -> pathlib.Path:
+    """The one sanctioned mover: full rewrite of TRANSFERBUDGET.json —
+    static census (may move either way; the committed diff is the
+    review surface) plus the traced per-flavor jaxpr census."""
+    from . import default_root
+
+    root = root if root is not None else default_root()
+    baseline_path, files = _paths(root, overrides or {})
+    readable = [p for p in files if pathlib.Path(p).is_file()]
+    total, by_label, errors, _ = static_transfer_census(root, readable)
+    if errors:
+        raise ValueError(f"census scope has syntax errors: {errors[0]}")
+    data = {
+        "static_transfer_sites": total,
+        "static_by_site": dict(sorted(by_label.items())),
+        "scope": [rel_path(pathlib.Path(p), root) for p in readable],
+        "traced": trace_transfer_census(),
+        "writer": ("python -m mpi_blockchain_tpu.analysis."
+                   "transfer_budget --write"),
+    }
+    baseline_path.write_text(json.dumps(data, indent=1, sort_keys=True)
+                             + "\n")
+    return baseline_path
+
+
+def main(argv=None) -> int:
+    import argparse
+    import sys
+
+    parser = argparse.ArgumentParser(
+        prog="python -m mpi_blockchain_tpu.analysis.transfer_budget",
+        description="the sanctioned TRANSFERBUDGET.json mover: traces "
+                    "the sweep callables (imports jax) and rewrites "
+                    "the committed budget; the chainlint gate itself "
+                    "stays stdlib-only")
+    parser.add_argument("--write", action="store_true",
+                        help="re-census and rewrite TRANSFERBUDGET.json")
+    parser.add_argument("--root", type=pathlib.Path, default=None)
+    args = parser.parse_args(argv)
+    if not args.write:
+        parser.error("nothing to do: pass --write")
+    try:
+        path = write_budget(args.root)
+    except (ValueError, OSError) as e:
+        print(f"transfer_budget: {e}", file=sys.stderr)
+        return 2
+    print(f"transfer_budget: wrote {path}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

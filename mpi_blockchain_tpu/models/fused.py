@@ -254,9 +254,17 @@ class FusedMiner:
                 data_words = np.stack([_words_be(core.sha256d(p))
                                        for p in payloads])
                 with span("fused.dispatch", k=k, height=height):
-                    nonces, prev = self._fn(k)(prev,
-                                               jnp.asarray(data_words),
-                                               np.uint32(height))
+                    # Justified DON002 suppression: the threaded buffer
+                    # is the (8,) u32 tip words — 32 bytes, replicated
+                    # over the mesh. Donating it saves nothing (XLA's
+                    # copy is smaller than the donation bookkeeping)
+                    # and the jit wrapper is shared with undonated
+                    # callers (maybe_shard_over_miners). The async
+                    # pipeline's REAL double buffers (ROADMAP item 1)
+                    # must donate — that is exactly what this rule is
+                    # armed for.
+                    nonces, prev = self._fn(k)(  # chainlint: disable=DON002
+                        prev, jnp.asarray(data_words), np.uint32(height))
             counter("device_dispatches_total",
                     help="jit'd multi-round search programs dispatched",
                     backend="tpu-fused").inc()

@@ -1545,11 +1545,99 @@ def _opb_case(tmp_path):
             src, "# ")
 
 
+# The SYNC fixture exercises exactly the two provenance shapes the live
+# miner loop uses: tuple unpacking of a backend search result, and the
+# closure/thread-body nonlocal writeback (fused dispatch_one idiom).
+BAD_SYNC = textwrap.dedent("""\
+    import numpy as np
+
+
+    class Miner:
+        def mine_block(self):
+            winner, count = self.backend.search(b"x", 20)
+            if count:                        # SYNC002: truthiness test
+                return int(winner)           # SYNC001: int() on device
+            return None
+
+        def mine_chain(self, n):
+            res = None
+
+            def _body():
+                nonlocal res
+                res = self.backend.search(b"x", 20)
+            _body()
+            host = np.asarray(res)           # SYNC001: closure writeback
+            return host
+
+
+    class FusedMiner:
+        def mine_chain(self, n):
+            self._mine_span(n)
+
+        def _mine_span(self, n):
+            out = self._searcher(20)(b"x", n)
+            while out[0]:                    # SYNC002: while test
+                out = self._searcher(20)(b"x", n)
+            return out.block_until_ready()   # SYNC001: explicit sync
+    """)
+
+
+BAD_DON = textwrap.dedent("""\
+    import functools
+    import jax
+
+    STATE = object()
+
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def sweep(buf, n):
+        return buf + n
+
+
+    class Miner:
+        def mine_block(self):
+            buf = self._alloc()
+            out = sweep(buf, 1)
+            return out, buf.sum()            # DON001: read after donate
+
+        def mine_chain(self, n):
+            out = sweep(self._state, 1)      # DON003: live attr donated
+            out2 = sweep(STATE, 1)           # DON003: module global
+            prev = self._prev
+            nonces, prev = self._fn(4)(prev, n)   # DON002: threaded
+            return out, out2, nonces
+    """)
+
+
+def _sync_case(tmp_path):
+    path = tmp_path / "bad_sync.py"
+    path.write_text(BAD_SYNC)
+    return {"sync_files": [path]}, "SYNC001", path, "# "
+
+
+def _don_case(tmp_path):
+    path = tmp_path / "bad_don.py"
+    path.write_text(BAD_DON)
+    return {"donation_files": [path]}, "DON001", path, "# "
+
+
+def _trb_case(tmp_path):
+    budget = tmp_path / "TRANSFERBUDGET.json"
+    budget.write_text(json.dumps({"static_transfer_sites": 0,
+                                  "traced": {}}))
+    src = tmp_path / "drain.py"
+    src.write_text("import numpy as np\n\n\ndef drain(x):\n"
+                   "    return np.asarray(x)\n")
+    return ({"transferbudget_json": budget, "transfer_files": [src]},
+            "TRB001", src, "# ")
+
+
 MATRIX_CASES = {
     "binding": _capi_case, "header": _chain_hpp_case, "jax": _jax_case,
     "sanitizers": _san_case, "telemetry": _tel_case,
     "resilience": _res_case, "conc": _conc_case, "spmd": _spmd_case,
-    "hotpath": _hot_case, "opbudget": _opb_case,
+    "hotpath": _hot_case, "opbudget": _opb_case, "sync": _sync_case,
+    "don": _don_case, "trb": _trb_case,
 }
 
 
@@ -2027,3 +2115,516 @@ def test_hotpath_path_open_method_fires(tmp_path):
     findings = run_hotpath_lint(ROOT, overrides={"hotpath_files": [path]})
     assert [f.rule for f in findings] == ["HOT001"], findings
     assert ".open()" in findings[0].message
+
+
+# ---- SYNC: device-sync provenance on the hot path ----------------------
+
+
+def _sync(tmp_path, text, name="bad_sync.py"):
+    from mpi_blockchain_tpu.analysis.sync_lint import run_sync_lint
+
+    path = tmp_path / name
+    path.write_text(text)
+    return run_sync_lint(ROOT, overrides={"sync_files": [path]})
+
+
+def test_sync_tuple_unpack_provenance_fires(tmp_path):
+    """`winner, count = backend.search(...)` taints BOTH names — the
+    unpacking shape the miner loop actually uses."""
+    findings = _sync(tmp_path, BAD_SYNC)
+    by_line = {(f.line, f.rule) for f in findings}
+    assert (7, "SYNC002") in by_line, findings   # `if count:`
+    assert (8, "SYNC001") in by_line, findings   # `int(winner)`
+    # The finding message carries the call chain from the root.
+    assert any("mine_block" in f.message for f in findings
+               if f.rule == "SYNC001" and f.line == 8)
+    assert all("retrace" in f.message for f in findings
+               if f.rule == "SYNC002")
+
+
+def test_sync_closure_thread_body_provenance_fires(tmp_path):
+    """The `nonlocal res; res = backend.search(...)` closure writeback
+    (the thread-body idiom) flows back into the enclosing scope."""
+    findings = _sync(tmp_path, BAD_SYNC)
+    asarray = [f for f in findings
+               if f.rule == "SYNC001" and "np.asarray" in f.message]
+    assert len(asarray) == 1 and asarray[0].line == 18, findings
+
+
+def test_sync_explicit_block_until_ready_and_while_fire(tmp_path):
+    findings = _sync(tmp_path, BAD_SYNC)
+    assert any(f.rule == "SYNC001" and "block_until_ready" in f.message
+               for f in findings), findings
+    assert any(f.rule == "SYNC002" and f.line == 28
+               for f in findings), findings
+
+
+def test_sync_seam_laundering_and_identity_checks_clean(tmp_path):
+    """replicated_host_value(s) is THE sanctioned materialization seam
+    (its result is host-origin), and `res is None` identity checks
+    never materialize — the live loop's two legitimate shapes."""
+    findings = _sync(tmp_path, textwrap.dedent("""\
+        class Miner:
+            def mine_block(self):
+                out = self._searcher(20)(b"x")
+                rounds, count = replicated_host_values(out)
+                if count:
+                    return int(count)
+                return None
+
+            def mine_chain(self, n):
+                res = self.backend.search(b"x", 20)
+                if res is None:
+                    return None
+                return res.nonce
+
+
+        class FusedMiner:
+            def mine_chain(self, n):
+                return self._mine_span(n)
+
+            def _mine_span(self, n):
+                return n
+        """))
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_sync_missing_entry_point_fires_sync003(tmp_path):
+    findings = _sync(tmp_path, "def helper():\n    return 1\n")
+    assert {f.rule for f in findings} == {"SYNC003"}
+    assert len(findings) == 4       # all four shared entry points
+    assert any("Miner.mine_chain" in f.message for f in findings)
+
+
+def test_sync_inline_suppression(tmp_path):
+    text = BAD_SYNC.replace(
+        "            return int(winner)           # SYNC001: int() on device",
+        "            return int(winner)  # chainlint: disable=SYNC001")
+    path = tmp_path / "bad_sync.py"
+    path.write_text(text)
+    findings = run_all(root=tmp_path, passes=["sync"],
+                       overrides={"sync_files": [path]})
+    flagged = [f for f in findings if f.rule == "SYNC001"]
+    assert len(flagged) == 2, findings      # line 8's is suppressed
+
+
+def test_sync_live_tree_clean():
+    """The live mine loops touch device values only through the
+    sanctioned seam — the invariant the async-dispatch refactor
+    (ROADMAP item 1) must preserve."""
+    from mpi_blockchain_tpu.analysis.sync_lint import run_sync_lint
+
+    findings = run_sync_lint(ROOT)
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_sync_cli_pass_family(tmp_path):
+    path = tmp_path / "bad_sync.py"
+    path.write_text(BAD_SYNC)
+    proc = subprocess.run(
+        [sys.executable, "-m", "mpi_blockchain_tpu.analysis",
+         "--passes", "sync", "--override", f"sync_files={path}"],
+        cwd=ROOT, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "SYNC001" in proc.stdout and "SYNC002" in proc.stdout
+
+
+# ---- DON: buffer-donation correctness ----------------------------------
+
+
+def _don(tmp_path, text, name="bad_don.py"):
+    from mpi_blockchain_tpu.analysis.donation_lint import run_donation_lint
+
+    path = tmp_path / name
+    path.write_text(text)
+    return run_donation_lint(ROOT, overrides={"donation_files": [path]})
+
+
+def test_don_use_after_donate_fires(tmp_path):
+    findings = _don(tmp_path, BAD_DON)
+    don1 = [f for f in findings if f.rule == "DON001"]
+    assert len(don1) == 1 and don1[0].line == 16, findings
+    assert "'buf'" in don1[0].message and "line 15" in don1[0].message
+
+
+def test_don_rebind_from_output_is_clean(tmp_path):
+    """`buf = sweep(buf, ...)` — rebinding the name from the call's own
+    outputs — is the donation idiom, not a use-after-donate."""
+    findings = _don(tmp_path, textwrap.dedent("""\
+        import functools
+        import jax
+
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def sweep(buf, n):
+            return buf + n
+
+
+        def pipeline(alloc, n):
+            buf = alloc()
+            buf = sweep(buf, 1)
+            return buf
+        """))
+    assert findings == [], findings
+
+
+def test_don_threaded_dispatch_fires_don002(tmp_path):
+    findings = _don(tmp_path, BAD_DON)
+    don2 = [f for f in findings if f.rule == "DON002"]
+    assert len(don2) == 1 and don2[0].line == 22, findings
+    assert "'prev'" in don2[0].message
+
+
+def test_don_threaded_dispatch_with_donation_clean(tmp_path):
+    """A donate= keyword at the site (or donate_argnums on the factory)
+    is the sanctioned evidence DON002 accepts."""
+    findings = _don(tmp_path, textwrap.dedent("""\
+        class FusedMiner:
+            def _mine_span(self, prev, n):
+                nonces, prev = self._fn(4, donate_argnums=(0,))(prev, n)
+                return nonces, prev
+        """))
+    assert findings == [], findings
+
+
+def test_don_live_host_state_fires_don003(tmp_path):
+    findings = _don(tmp_path, BAD_DON)
+    don3 = sorted((f.line, f.rule) for f in findings
+                  if f.rule == "DON003")
+    assert don3 == [(19, "DON003"), (20, "DON003")], findings
+    msgs = [f.message for f in findings if f.rule == "DON003"]
+    assert any("self._state" in m for m in msgs)
+    assert any("STATE" in m for m in msgs)
+
+
+def test_don_inline_suppression(tmp_path):
+    text = BAD_DON.replace(
+        "        nonces, prev = self._fn(4)(prev, n)   # DON002: threaded",
+        "        nonces, prev = self._fn(4)(prev, n)  "
+        "# chainlint: disable=DON002")
+    path = tmp_path / "bad_don.py"
+    path.write_text(text)
+    findings = run_all(root=tmp_path, passes=["don"],
+                       overrides={"donation_files": [path]})
+    assert "DON002" not in {f.rule for f in findings}
+    assert "DON001" in {f.rule for f in findings}   # others still gate
+
+
+def test_don_live_tree_justified_suppression_only():
+    """The live tree holds exactly one DON finding raw — the fused
+    miner's 32-byte tip-words thread — and it is suppressed with a
+    written justification (PR 8 precedent), so the gate is green."""
+    from mpi_blockchain_tpu.analysis import apply_suppressions
+    from mpi_blockchain_tpu.analysis.donation_lint import run_donation_lint
+
+    raw = run_donation_lint(ROOT)
+    assert [(f.rule, f.file) for f in raw] == \
+        [("DON002", "mpi_blockchain_tpu/models/fused.py")], raw
+    assert apply_suppressions(raw, ROOT) == []
+
+
+def test_don_cli_pass_family(tmp_path):
+    path = tmp_path / "bad_don.py"
+    path.write_text(BAD_DON)
+    proc = subprocess.run(
+        [sys.executable, "-m", "mpi_blockchain_tpu.analysis",
+         "--passes", "don", "--override", f"donation_files={path}"],
+        cwd=ROOT, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "DON001" in proc.stdout and "DON003" in proc.stdout
+
+
+# ---- TRB: the device-transfer ratchet ----------------------------------
+
+
+def _transfer_budget_json(tmp_path, **over):
+    data = {"static_transfer_sites": 999, "traced": {}, **over}
+    path = tmp_path / "TRANSFERBUDGET.json"
+    path.write_text(json.dumps(data))
+    return path
+
+
+def test_trb_live_tree_gate_is_armed_and_green():
+    from mpi_blockchain_tpu.analysis.transfer_budget import (
+        run_transfer_budget)
+
+    assert (ROOT / "TRANSFERBUDGET.json").is_file(), \
+        "the committed TRANSFERBUDGET.json is the transfer ratchet gate"
+    assert run_transfer_budget(ROOT) == []
+    # The committed baseline carries the traced per-flavor census the
+    # sanctioned mover wrote (the physically-meaningful numbers).
+    data = json.loads((ROOT / "TRANSFERBUDGET.json").read_text())
+    assert {"tpu_multiround", "fused"} <= set(data["traced"])
+    for flavor in data["traced"].values():
+        assert flavor["total_transfer_prims"] >= 0
+
+
+def test_trb_grown_census_fires_trb001(tmp_path):
+    from mpi_blockchain_tpu.analysis.transfer_budget import (
+        run_transfer_budget)
+
+    budget = _transfer_budget_json(tmp_path, static_transfer_sites=1)
+    src = tmp_path / "drain.py"
+    src.write_text("import numpy as np\n\n\ndef drain(x):\n"
+                   "    a = np.asarray(x)\n    b = x.item()\n"
+                   "    return a, b\n")
+    findings = run_transfer_budget(
+        ROOT, overrides={"transferbudget_json": budget,
+                         "transfer_files": [src]})
+    assert [f.rule for f in findings] == ["TRB001"], findings
+    assert findings[0].file == str(src) and findings[0].line == 5
+    assert "2 > budget 1" in findings[0].message
+
+
+def test_trb_missing_or_malformed_baseline_fires_trb002(tmp_path):
+    from mpi_blockchain_tpu.analysis.transfer_budget import (
+        run_transfer_budget)
+
+    for budget in (tmp_path / "absent.json",
+                   _transfer_budget_json(tmp_path,
+                                         static_transfer_sites=-3)):
+        findings = run_transfer_budget(
+            ROOT, overrides={"transferbudget_json": budget})
+        assert [f.rule for f in findings] == ["TRB002"], findings
+    bad = tmp_path / "TRANSFERBUDGET.json"
+    bad.write_text("{not json")
+    findings = run_transfer_budget(
+        ROOT, overrides={"transferbudget_json": bad})
+    assert [f.rule for f in findings] == ["TRB002"], findings
+
+
+def test_trb_empty_scope_fires_trb003(tmp_path):
+    from mpi_blockchain_tpu.analysis.transfer_budget import (
+        run_transfer_budget)
+
+    budget = _transfer_budget_json(tmp_path)
+    findings = run_transfer_budget(
+        ROOT, overrides={"transferbudget_json": budget,
+                         "transfer_files": [tmp_path / "gone.py"]})
+    assert [f.rule for f in findings] == ["TRB003"], findings
+
+
+def test_trb_rebaseline_refuses_upward(tmp_path):
+    from mpi_blockchain_tpu.analysis.transfer_budget import (
+        rebaseline_transfers)
+
+    budget = _transfer_budget_json(tmp_path, static_transfer_sites=0)
+    src = tmp_path / "drain.py"
+    src.write_text("import numpy as np\n\n\ndef drain(x):\n"
+                   "    return np.asarray(x)\n")
+    with pytest.raises(ValueError, match="refusing to rebaseline"):
+        rebaseline_transfers(ROOT, {"transferbudget_json": budget,
+                                    "transfer_files": [src]})
+    # Refusal must not touch the committed file.
+    assert json.loads(budget.read_text())["static_transfer_sites"] == 0
+
+
+def test_trb_rebaseline_ratchets_down(tmp_path):
+    from mpi_blockchain_tpu.analysis.transfer_budget import (
+        rebaseline_transfers)
+
+    budget = _transfer_budget_json(tmp_path, static_transfer_sites=7)
+    src = tmp_path / "drain.py"
+    src.write_text("import numpy as np\n\n\ndef drain(x):\n"
+                   "    return np.asarray(x)\n")
+    old, new, path = rebaseline_transfers(
+        ROOT, {"transferbudget_json": budget, "transfer_files": [src]})
+    assert (old, new) == (7, 1)
+    data = json.loads(path.read_text())
+    assert data["static_transfer_sites"] == 1
+    assert data["traced"] == {}     # the mover's section is preserved
+    assert data["static_by_site"] == {"np.asarray": 1}
+    # The scope list describes the files the counts came from.
+    assert data["scope"] == [str(src)]
+
+
+def test_trb_rebaseline_requires_valid_baseline(tmp_path):
+    from mpi_blockchain_tpu.analysis.transfer_budget import (
+        rebaseline_transfers)
+
+    src = tmp_path / "drain.py"
+    src.write_text("x = 1\n")
+    with pytest.raises(ValueError, match="no valid baseline"):
+        rebaseline_transfers(
+            ROOT, {"transferbudget_json": tmp_path / "absent.json",
+                   "transfer_files": [src]})
+
+
+def test_trb_cli_rebaseline_refusal_exits_2(tmp_path):
+    budget = _transfer_budget_json(tmp_path, static_transfer_sites=0)
+    src = tmp_path / "drain.py"
+    src.write_text("import numpy as np\n\n\ndef drain(x):\n"
+                   "    return np.asarray(x)\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "mpi_blockchain_tpu.analysis",
+         "--rebaseline-transfers",
+         "--override", f"transferbudget_json={budget}",
+         "--override", f"transfer_files={src}"],
+        cwd=ROOT, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+    assert "refused" in proc.stderr
+
+
+def test_trb_cli_pass_family(tmp_path):
+    budget = _transfer_budget_json(tmp_path, static_transfer_sites=0)
+    src = tmp_path / "drain.py"
+    src.write_text("import numpy as np\n\n\ndef drain(x):\n"
+                   "    return np.asarray(x)\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "mpi_blockchain_tpu.analysis",
+         "--passes", "trb",
+         "--override", f"transferbudget_json={budget}",
+         "--override", f"transfer_files={src}"],
+        cwd=ROOT, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "TRB001" in proc.stdout
+
+
+# ---- v3 families: audit + timings integration --------------------------
+
+
+def test_audit_reports_stale_sync_suppression(tmp_path):
+    """The stale-suppression audit covers the new families: a
+    `chainlint: disable=SYNC001` on a line where the rule no longer
+    fires is reported (and a live one is not)."""
+    from mpi_blockchain_tpu.analysis import audit_suppressions
+
+    root, pkg = _audit_root(tmp_path)
+    mod = pkg / "mod.py"
+    mod.write_text("x = 1  # chainlint: disable=SYNC001\n"
+                   "y = 2  # chainlint: disable=DON002\n"
+                   "z = 3  # chainlint: disable=TRB001\n")
+    warnings = audit_suppressions(root=root,
+                                  passes=["sync", "don", "trb"],
+                                  overrides={"sync_files": [mod],
+                                             "donation_files": [mod],
+                                             "transfer_files": [mod]})
+    assert len(warnings) == 3, warnings
+    assert any("SYNC001" in w and "mod.py:1" in w for w in warnings)
+    assert any("DON002" in w for w in warnings)
+    assert any("TRB001" in w for w in warnings)
+
+
+def test_cli_json_timings_include_v3_passes(tmp_path):
+    """pass_timings_ms carries the three new families (the `make lint`
+    wall-time budget is observable per pass)."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "mpi_blockchain_tpu.analysis",
+         "--passes", "sync,don,trb", "--json", "-q"],
+        cwd=ROOT, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["findings"] == []
+    assert set(payload["pass_timings_ms"]) == {"sync", "don", "trb"}
+    assert all(t >= 0 for t in payload["pass_timings_ms"].values())
+
+
+# ---- review hardening: v3 edge cases ------------------------------------
+
+
+def test_don_donate_argnames_counts_as_declared(tmp_path):
+    """donate_argnames (and computed donate_argnums) are donation
+    DECLARATIONS: DON002 must not fire on a wrapper that donates by
+    name — exactly the double-buffer idiom ROADMAP item 1 adopts."""
+    findings = _don(tmp_path, textwrap.dedent("""\
+        import jax
+
+
+        def body(state, x):
+            return state + x, x
+
+
+        step = jax.jit(body, donate_argnames=("state",))
+
+
+        def drive(state, xs):
+            for x in xs:
+                state, out = step(state, x)
+            return state
+        """))
+    assert findings == [], findings
+
+
+def test_don_multiline_donated_call_is_not_use_after(tmp_path):
+    """A donated call's own multiline argument list must not read as a
+    later load of the donated name (a line-length reflow is not a
+    use-after-donate)."""
+    findings = _don(tmp_path, textwrap.dedent("""\
+        import functools
+        import jax
+
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def sweep(buf, n):
+            return buf + n
+
+
+        def drive(alloc):
+            buf = alloc()
+            out = sweep(
+                buf, 1)
+            return out
+        """))
+    assert findings == [], findings
+
+
+def test_sync_reachable_closure_in_unreachable_setup_is_walked(tmp_path):
+    """A closure DEFINED in setup code (__init__) but CALLED from the
+    hot path gets its own provenance walk — being nested only skips the
+    walk when an ancestor is itself reachable."""
+    findings = _sync(tmp_path, textwrap.dedent("""\
+        class Miner:
+            def __init__(self):
+                def _cb(backend):
+                    return int(backend.search(b"x", 20))
+                self._cb = _cb
+
+            def mine_block(self):
+                return self._cb(self.backend)
+
+            def mine_chain(self, n):
+                return self.mine_block()
+
+
+        class FusedMiner:
+            def mine_chain(self, n):
+                return self._mine_span(n)
+
+            def _mine_span(self, n):
+                return n
+        """))
+    assert any(f.rule == "SYNC001" and f.line == 4
+               for f in findings), findings
+
+
+def test_sync_compiled_regex_search_is_not_device_origin(tmp_path):
+    """`pat.search(line)` (the compiled-pattern spelling of re.search)
+    must not taint: branching on a regex match is host work."""
+    findings = _sync(tmp_path, textwrap.dedent("""\
+        import re
+
+        _PAT = re.compile(r"rank=(\\d+)")
+
+
+        class Miner:
+            def mine_block(self):
+                m = _PAT.search("rank=3")
+                if m:
+                    return int(m.group(1))
+                n = re.search(r"x", "x")
+                if n:
+                    return 1
+                return 0
+
+            def mine_chain(self, n):
+                return self.mine_block()
+
+
+        class FusedMiner:
+            def mine_chain(self, n):
+                return self._mine_span(n)
+
+            def _mine_span(self, n):
+                return n
+        """))
+    assert findings == [], "\n".join(f.render() for f in findings)
