@@ -6,10 +6,11 @@ SHELL := /bin/bash   # tier1 uses pipefail/PIPESTATUS
 
 .PHONY: check lint lint-fast metrics-smoke forensics-smoke perf-smoke \
         chaos-smoke adversary-smoke meshwatch-smoke elastic-smoke \
-        trace-smoke tier1 core clean
+        trace-smoke pipeline-smoke tier1 core clean
 
 check: lint metrics-smoke forensics-smoke perf-smoke chaos-smoke \
-        adversary-smoke meshwatch-smoke elastic-smoke trace-smoke tier1
+        adversary-smoke meshwatch-smoke elastic-smoke trace-smoke \
+        pipeline-smoke tier1
 
 # chainlint: binding contract, header layout, JAX purity, sanitizer
 # matrix, thread races (CONC), SPMD collectives, hot-path blocking,
@@ -146,6 +147,18 @@ trace-smoke:
 	env JAX_PLATFORMS=cpu $(PY) -m mpi_blockchain_tpu.blocktrace smoke \
 	    2>/dev/null || { echo "trace-smoke: failed"; exit 1; }; \
 	echo "trace-smoke: ok"
+
+# Pipeline smoke: the ROADMAP-item-1 gate — the async double-buffered
+# miner's measured bubble_fraction on the fixed-seed instrumented mine
+# must pass its SECTION_BOUNDS absolute budget (<= 0.15), the pipelined
+# chain must be byte-identical to the sequential oracle, and `device`
+# must dominate every block's critical path (docs/perfwatch.md
+# §Pipelined dispatch).
+pipeline-smoke:
+	env JAX_PLATFORMS=cpu $(PY) -m mpi_blockchain_tpu.meshwatch \
+	    pipeline-smoke 2>/dev/null || \
+	    { echo "pipeline-smoke: failed"; exit 1; }; \
+	echo "pipeline-smoke: ok"
 
 # Perfwatch smoke: serve a faulted instrumented run, scrape /metrics +
 # /healthz live, then prove the regression sentinel flags an injected

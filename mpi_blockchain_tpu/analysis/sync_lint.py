@@ -72,7 +72,12 @@ from .callgraph import CallGraph, FuncInfo, call_name, dotted
 from .hotpath_lint import ENTRY_POINTS, SANCTIONED_SEAMS
 
 #: Calls whose RESULT is device-origin (by rightmost name).
-DEVICE_PRODUCING_CALLS = {"search"}
+#: ``search_async`` is the double-buffered pipeline's future-returning
+#: dispatch seam (backend.search_async): the future wraps a device
+#: value, so touching it with a sync primitive is the same stall —
+#: consuming it through ``.result()`` (attribute access) launders, per
+#: the SearchResult materialized-field contract.
+DEVICE_PRODUCING_CALLS = {"search", "search_async"}
 
 #: ``search`` sites that are NOT device dispatches (dotted prefixes).
 _SEARCH_EXEMPT_PREFIXES = ("re.", "regex.")

@@ -9,6 +9,7 @@ identical block hashes (SURVEY.md §7 hard part #3).
 from __future__ import annotations
 
 import abc
+import concurrent.futures
 import dataclasses
 
 from ..config import ConfigError
@@ -21,6 +22,26 @@ class SearchResult:
     hashes_tried: int        # total nonces evaluated (for hashes/sec metrics)
 
 
+def sync_search_future(search_fn, header80: bytes, difficulty_bits: int,
+                       start_nonce: int = 0,
+                       max_count: int = 1 << 32
+                       ) -> "concurrent.futures.Future":
+    """The degenerate (synchronous) form of the async dispatch seam:
+    runs ``search_fn`` inline and returns an already-completed future,
+    so a driver written against ``search_async`` degrades to the exact
+    sequential one-deep pipeline on backends without a real async
+    dispatch path. Exceptions travel through the future, like a real
+    dispatch's would."""
+    f: concurrent.futures.Future = concurrent.futures.Future()
+    try:
+        f.set_result(search_fn(header80, difficulty_bits,
+                               start_nonce=start_nonce,
+                               max_count=max_count))
+    except BaseException as e:   # delivered to the consumer, not lost
+        f.set_exception(e)
+    return f
+
+
 class MinerBackend(abc.ABC):
     """Abstract nonce-search engine behind the plugin boundary."""
 
@@ -31,6 +52,31 @@ class MinerBackend(abc.ABC):
                start_nonce: int = 0,
                max_count: int = 1 << 32) -> SearchResult:
         """Finds the lowest qualifying nonce in the given range."""
+
+    def search_async(self, header80: bytes, difficulty_bits: int,
+                     start_nonce: int = 0,
+                     max_count: int = 1 << 32
+                     ) -> "concurrent.futures.Future":
+        """Future-returning dispatch: the seam the double-buffered miner
+        pipeline (models/miner.py) drives, letting the host validate /
+        append / checkpoint block N while sweep N+1 runs. The contract
+        on top of ``search``'s:
+
+        * same determinism — the future resolves to exactly what
+          ``search`` with the same arguments would return;
+        * FIFO completion — two dispatches issued back-to-back resolve
+          in issue order (the driver additionally consumes strictly in
+          issue order, so the lowest-nonce rule survives even a backend
+          whose futures complete out of order);
+        * errors arrive through the future, never at submission.
+
+        Default implementation: the degenerate synchronous one-deep
+        pipeline (``sync_search_future``). ``ResilientBackend``
+        overrides it with a real single-flight dispatch worker.
+        """
+        return sync_search_future(self.search, header80, difficulty_bits,
+                                  start_nonce=start_nonce,
+                                  max_count=max_count)
 
 
 _REGISTRY: dict[str, type[MinerBackend]] = {}

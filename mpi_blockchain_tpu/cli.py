@@ -334,6 +334,21 @@ def cmd_mine(args) -> int:
         summary.update(hashes_tried=miner.total_hashes(),
                        hashes_per_sec=round(miner.hashes_per_sec()),
                        backend=miner.backend.name)
+    from .meshwatch.pipeline import pipeline_report
+    from .telemetry.registry import default_registry as _default_registry
+    pipe = pipeline_report()
+    if pipe["dispatch_count"]:
+        # The async-dispatch headline (ROADMAP item 1):
+        # host_overlapped_fraction is how much host work hid behind
+        # in-flight dispatches; bubble_fraction is the device idle share
+        # the pipeline exists to close (docs/perfwatch.md).
+        summary["pipeline"] = {
+            "host_overlapped_fraction": pipe["host_overlapped_fraction"],
+            "bubble_fraction": pipe["bubble_fraction"],
+            "speculative_discards": int(
+                sum(m.value for m in _default_registry().metrics()
+                    if m.name == "speculative_discards_total")),
+        }
     if world is not None:
         summary["mesh"] = world.summary()
         if hasattr(miner.backend, "n_live"):   # ElasticMeshBackend

@@ -103,6 +103,23 @@ def set_nonce(header80: bytes, nonce: int) -> bytes:
     return header80[:76] + struct.pack("<I", nonce)
 
 
+def make_candidate_header(prev_hash: bytes, data: bytes, height: int,
+                          bits: int) -> bytes:
+    """Python twin of ``Node::make_candidate`` (chain.cpp) for a KNOWN
+    prev digest: the pipelined miner builds block ``height``'s candidate
+    from sweep N's winning digest *before* the C++ append lands, which
+    is what lets sweep N+1 dispatch while the host validates/appends N.
+    Field-for-field identical to the C++ builder: version = kVersion
+    (1), deterministic timestamp == height, nonce = 0. The driver
+    re-checks equality against ``node.make_candidate`` at every block
+    boundary and discards the speculation on any mismatch (e.g. a
+    retarget schedule changing ``bits``), so drift here degrades to a
+    discarded dispatch, never a divergent chain."""
+    return HeaderFields(version=1, prev_hash=prev_hash,
+                        data_hash=sha256d(data), timestamp=int(height),
+                        bits=int(bits), nonce=0).pack()
+
+
 class RecvResult:
     """Mirror of chaincore::RecvResult."""
     APPENDED = 0

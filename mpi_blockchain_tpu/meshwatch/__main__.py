@@ -1,4 +1,5 @@
-"""CLI: python -m mpi_blockchain_tpu.meshwatch {merge,report,watch,smoke}
+"""CLI: python -m mpi_blockchain_tpu.meshwatch
+        {merge,report,watch,smoke,bubble,pipeline-smoke}
 
     # one mesh-wide view of a shard directory (counters summed,
     # gauges/histograms per-rank), with rank liveness
@@ -16,6 +17,12 @@
 virtual-cpu world with ``--mesh-obs``, SIGKILL one rank mid-run, then
 prove the merged view sums the per-rank counters, names exactly the
 killed rank as stale, and renders a non-empty pipeline report + trace.
+
+``pipeline-smoke`` is the ROADMAP-item-1 gate (``make pipeline-smoke``):
+the fixed-seed instrumented mine's pipelined ``bubble_fraction`` stays
+inside the SECTION_BOUNDS budget (<= 0.15), the pipelined chain is
+byte-identical to the sequential oracle, and ``device`` dominates every
+block's critical path; ``bubble`` prints the raw measurement payload.
 """
 from __future__ import annotations
 
@@ -234,6 +241,87 @@ def cmd_smoke(args) -> int:
     return 0
 
 
+def cmd_bubble(args) -> int:
+    """Measure the pipeline_bubble bench payload (before/after
+    bubble_fraction of the fixed-seed instrumented mine) and print it —
+    `perfwatch record --section pipeline_bubble` appends it to
+    PERF_HISTORY.jsonl (the measure -> gate -> record shape)."""
+    import logging
+
+    from .bubble import measure_pipeline_bubble
+
+    # The audit mines through the real checkpoint seam: its
+    # block_mined/checkpoint_saved log lines are noise on a
+    # measurement's stdout.
+    logging.getLogger("mpi_blockchain_tpu").setLevel(logging.WARNING)
+    payload = measure_pipeline_bubble()
+    print(json.dumps({"event": "pipeline_bubble", **payload},
+                     sort_keys=True))
+    return 0
+
+
+def cmd_pipeline_smoke(args) -> int:
+    """The make pipeline-smoke gate (ROADMAP item 1 acceptance):
+
+    1. the fixed-seed instrumented mine's PIPELINED ``bubble_fraction``
+       passes the SECTION_BOUNDS absolute budget (<= 0.15), judged
+       through the perfwatch detector like every bounded section
+       (best-of-<=3: a real regression cannot produce a clean read, a
+       scheduler-weather spike cannot produce three dirty ones);
+    2. the pipelined chain is byte-identical to the sequential oracle's
+       (``chain_identical`` — the determinism half of the acceptance);
+    3. ``device`` is the dominant per-block critical-path stage on
+       every mined block of the pipelined leg (the blocktrace form:
+       host work hides behind the in-flight dispatch).
+    """
+    import logging
+
+    from ..perfwatch.detector import check_candidate
+    from ..perfwatch.history import DEFAULT_HISTORY_NAME, HistoryStore
+    from .bubble import measure_pipeline_bubble
+
+    logging.getLogger("mpi_blockchain_tpu").setLevel(logging.WARNING)
+    repo_root = pathlib.Path(__file__).resolve().parent.parent.parent
+    store = HistoryStore(repo_root / DEFAULT_HISTORY_NAME)
+    for attempt in range(3):
+        payload = measure_pipeline_bubble()
+        finding = check_candidate(store, "pipeline_bubble", payload)
+        if not payload["chain_identical"]:
+            # Determinism is not weather: one broken chain fails the
+            # gate outright, no retry.
+            print(f"pipeline-smoke: pipelined chain diverged from the "
+                  f"sequential oracle: {payload}", file=sys.stderr)
+            return 1
+        ok = (finding.verdict != "regression"
+              and payload["device_dominant_blocks"] == payload["blocks"])
+        if ok:
+            break
+        print(f"pipeline-smoke: read {attempt + 1} dirty "
+              f"(bubble {payload['bubble_fraction']}, device-dominant "
+              f"{payload['device_dominant_blocks']}/{payload['blocks']})",
+              file=sys.stderr)
+    if finding.verdict == "regression":
+        print(f"pipeline-smoke: bubble over budget: {finding.render()}",
+              file=sys.stderr)
+        return 1
+    if payload["device_dominant_blocks"] != payload["blocks"]:
+        print(f"pipeline-smoke: device not dominant on every block "
+              f"({payload['device_dominant_blocks']}/"
+              f"{payload['blocks']})", file=sys.stderr)
+        return 1
+    print(json.dumps({
+        "event": "pipeline_smoke", "ok": True,
+        "bubble_fraction": payload["bubble_fraction"],
+        "bubble_fraction_sequential":
+            payload["bubble_fraction_sequential"],
+        "host_overlapped_fraction": payload["host_overlapped_fraction"],
+        "device_dominant_blocks": payload["device_dominant_blocks"],
+        "blocks": payload["blocks"],
+        "verdict": finding.verdict,
+    }, sort_keys=True))
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m mpi_blockchain_tpu.meshwatch",
@@ -280,6 +368,18 @@ def main(argv: list[str] | None = None) -> int:
 
     p_smk = sub.add_parser("smoke", help="the make meshwatch-smoke gate")
     p_smk.set_defaults(fn=cmd_smoke)
+
+    p_bub = sub.add_parser("bubble", help="measure the pipeline_bubble "
+                                          "bench payload (before/after "
+                                          "bubble_fraction of the fixed-"
+                                          "seed instrumented mine)")
+    p_bub.set_defaults(fn=cmd_bubble)
+
+    p_psm = sub.add_parser("pipeline-smoke",
+                           help="the make pipeline-smoke gate: bubble "
+                                "budget + oracle-identical chain + "
+                                "device-dominant blocks")
+    p_psm.set_defaults(fn=cmd_pipeline_smoke)
 
     args = parser.parse_args(argv)
     try:

@@ -221,6 +221,47 @@ class PipelineProfiler:
             self._next_id = 0
 
 
+def strip_block_identity(record: dict, keep_k: int | None = None,
+                         segments: bool = False) -> None:
+    """Strips the block identity from a dispatch record that was
+    abandoned — a fused recovery bail-out's in-flight batches, or the
+    pipelined miner's discarded speculative dispatches. The heights an
+    abandoned dispatch was stamped for WILL be mined by a live dispatch,
+    and the critical-path join must never merge a dead dispatch's slices
+    into the real block's waterfall: the work stays visible as
+    ``unattributed``, never silently dropped, never double-counted
+    (blocktrace attribution rules, docs/observability.md §blocktrace).
+
+    ``keep_k``: the fused partial-batch case — the first ``keep_k``
+    blocks of the batch WERE appended, so the meta keeps its height with
+    ``k`` clamped to the appended prefix instead of losing identity
+    entirely. ``segments=True`` additionally strips per-segment
+    ``height``/``template`` stamps (the miner's speculative dispatches
+    record their segments inside ``trace_block`` scopes; the fused
+    bail-out keeps its exact drain-side stamps — that work is real).
+
+    Everything is REBOUND to fresh dicts, never mutated in place: the
+    meshwatch shard flusher thread shallow-copies records and may be
+    json-serializing the old dicts concurrently (rebinding is atomic
+    under the GIL; an in-place ``del`` would crash its iteration).
+    Key-guarded so the telemetry-off shared null record is never
+    written."""
+    meta = record.get("meta") or {}
+    if "height" in meta:
+        meta = dict(meta)
+        if keep_k:
+            meta["k"] = keep_k
+        else:
+            del meta["height"]
+        record["meta"] = meta
+    if segments:
+        segs = record.get("segments") or []
+        if any("height" in s or "template" in s for s in segs):
+            record["segments"] = [
+                {k: v for k, v in s.items()
+                 if k not in ("height", "template")} for s in segs]
+
+
 # ---- the process-default profiler ----------------------------------------
 
 _default = PipelineProfiler()

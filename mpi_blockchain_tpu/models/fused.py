@@ -33,7 +33,7 @@ from .. import core
 from ..blocktrace import trace_block
 from ..blocktrace.critical_path import observe_batch_metrics
 from ..config import MAX_EXTRA_NONCE, ConfigError, extend_payload
-from ..meshwatch.pipeline import profiler
+from ..meshwatch.pipeline import profiler, strip_block_identity
 from ..telemetry import counter, heartbeat, histogram
 from ..telemetry.spans import span
 from ..ops.sha256_jnp import (IV, _bswap32, compress,
@@ -51,7 +51,7 @@ def _words_be(digest32: bytes) -> np.ndarray:
 
 def make_fused_miner(k_blocks: int, batch_pow2: int, difficulty_bits: int,
                      n_miners: int = 1, mesh=None, kernel: str = "auto",
-                     max_rounds: int | None = None):
+                     max_rounds: int | None = None, donate: bool = False):
     """Builds the jit'd k-block miner.
 
     Returns fn(prev_words (8,) u32, data_words (k,8) u32, start_height u32)
@@ -59,6 +59,14 @@ def make_fused_miner(k_blocks: int, batch_pow2: int, difficulty_bits: int,
     qualifying hash cannot be distinguished on-device per block, so the host
     validator (Node.submit) is the arbiter — any search failure surfaces as
     a validation error there (practically impossible below difficulty ~60).
+
+    ``donate=True`` declares ``prev_words`` donated (chainlint DON002):
+    the tip-words buffer is threaded output -> input across back-to-back
+    pipelined dispatches (``_mine_span``), the load-bearing double-buffer
+    idiom — donating it lets XLA reuse the buffer instead of copying per
+    dispatch, and the caller's rebind-from-output (``nonces, prev =
+    fn(prev, ...)``) is exactly the DON001-clean handoff the donation
+    contract requires.
     """
     batch = 1 << batch_pow2
     round_size = batch * n_miners
@@ -110,7 +118,9 @@ def make_fused_miner(k_blocks: int, batch_pow2: int, difficulty_bits: int,
         return nonces, tip
 
     from ..parallel.mesh import maybe_shard_over_miners
-    return maybe_shard_over_miners(mine_k, n_miners, mesh, n_out=2)
+    return maybe_shard_over_miners(
+        mine_k, n_miners, mesh, n_out=2,
+        donate_argnames=("prev_words",) if donate else ())
 
 
 class FusedMiner:
@@ -135,7 +145,7 @@ class FusedMiner:
         self.node = core.Node(config.difficulty_bits, node_id)
         self.blocks_per_call = blocks_per_call
         self._mesh = mesh
-        self._fns: dict[int, object] = {}
+        self._fns: dict[tuple[int, bool], object] = {}
         # Per-block backend for the nonce-exhaustion rollover path; built
         # lazily (the path is ~unreachable below difficulty ~34).
         # Injectable so tests can stage an exhaustion deterministically.
@@ -145,15 +155,22 @@ class FusedMiner:
             log_fn = block_logger()
         self._log = log_fn
 
-    def _fn(self, k: int):
-        fn = self._fns.get(k)
+    def _fn(self, k: int, donate: bool = True):
+        """The cached k-block device program, keyed on (k, donate) so a
+        cache hit can never hand out the wrong donation flavor.
+        ``donate`` (always True in practice — the default exists so the
+        dispatch site can SPELL the donation, which is what chainlint
+        DON002 keys on) threads through to ``make_fused_miner``'s
+        ``donate_argnames`` declaration."""
+        key = (k, donate)
+        fn = self._fns.get(key)
         if fn is None:
             fn = make_fused_miner(
                 k, self.config.effective_batch_pow2,
                 self.config.difficulty_bits,
                 n_miners=self.config.n_miners, mesh=self._mesh,
-                kernel=self.config.kernel)
-            self._fns[k] = fn
+                kernel=self.config.kernel, donate=donate)
+            self._fns[key] = fn
         return fn
 
     def warmup(self, k: int | None = None) -> None:
@@ -171,7 +188,7 @@ class FusedMiner:
         if not hasattr(fn, "lower"):    # already an AOT executable
             return
         u32 = np.uint32
-        self._fns[k] = fn.lower(
+        self._fns[(k, True)] = fn.lower(
             jax.ShapeDtypeStruct((8,), u32),
             jax.ShapeDtypeStruct((k, 8), u32),
             jax.ShapeDtypeStruct((), u32)).compile()
@@ -254,16 +271,13 @@ class FusedMiner:
                 data_words = np.stack([_words_be(core.sha256d(p))
                                        for p in payloads])
                 with span("fused.dispatch", k=k, height=height):
-                    # Justified DON002 suppression: the threaded buffer
-                    # is the (8,) u32 tip words — 32 bytes, replicated
-                    # over the mesh. Donating it saves nothing (XLA's
-                    # copy is smaller than the donation bookkeeping)
-                    # and the jit wrapper is shared with undonated
-                    # callers (maybe_shard_over_miners). The async
-                    # pipeline's REAL double buffers (ROADMAP item 1)
-                    # must donate — that is exactly what this rule is
-                    # armed for.
-                    nonces, prev = self._fn(k)(  # chainlint: disable=DON002
+                    # prev_words is DONATED (declared on the jit via
+                    # make_fused_miner donate=True): the tip-words
+                    # buffer is handed output -> input across pipelined
+                    # dispatches, and rebinding `prev` from the call's
+                    # own outputs is the DON001-clean handoff. The
+                    # donated input must never be read after this line.
+                    nonces, prev = self._fn(k, donate=True)(
                         prev, jnp.asarray(data_words), np.uint32(height))
             counter("device_dispatches_total",
                     help="jit'd multi-round search programs dispatched",
@@ -327,36 +341,19 @@ class FusedMiner:
                         # The rest of this batch and every queued
                         # in-flight dispatch are discarded — their
                         # heights will be re-mined after recovery, so
-                        # strip the dead records' block identity: the
-                        # critical-path join must not merge slices from
-                        # an abandoned dispatch into the re-mined
-                        # block's waterfall (the work stays visible as
-                        # unattributed, never silently dropped). The
-                        # exact per-segment stamps (validate/append of
-                        # appended blocks, and this failed attempt)
-                        # survive — that work is real.
-                        # Each record's meta is REBOUND to a fresh dict,
-                        # never mutated in place: the meshwatch shard
-                        # flusher thread shallow-copies records and may
-                        # be json-serializing the old meta concurrently
-                        # (rebinding is atomic under the GIL; in-place
-                        # del would crash its iteration). Key-guarded so
-                        # the telemetry-off shared null record is never
-                        # written.
-                        meta = prec.record.get("meta") or {}
-                        if "height" in meta:
-                            meta = dict(meta)
-                            if j:
-                                meta["k"] = j
-                            else:
-                                del meta["height"]
-                            prec.record["meta"] = meta
+                        # strip the dead records' block identity
+                        # (meshwatch.pipeline.strip_block_identity, the
+                        # same rule the pipelined miner's speculative
+                        # discards follow): the critical-path join must
+                        # not merge slices from an abandoned dispatch
+                        # into the re-mined block's waterfall (the work
+                        # stays visible as unattributed, never silently
+                        # dropped). The exact per-segment stamps
+                        # (validate/append of appended blocks, and this
+                        # failed attempt) survive — that work is real.
+                        strip_block_identity(prec.record, keep_k=j)
                         for stale in batches:
-                            s_meta = stale[3].record.get("meta") or {}
-                            if "height" in s_meta:
-                                s_meta = {k_: v for k_, v in s_meta.items()
-                                          if k_ != "height"}
-                                stale[3].record["meta"] = s_meta
+                            strip_block_identity(stale[3].record)
                         self._recover_block(batch_height + j + 1,
                                             int(nonces[j]))
                         return self.node.height - start

@@ -4,20 +4,53 @@ Mirrors the reference's Node::run mine loop (SURVEY.md §3.2) with the
 boundaries moved per §3.4: the hot nonce loop lives in one jit'd device
 program per round; the host only appends winners. Chain state is canonical in
 the C++ Node; the search runs behind the miner_backend plugin boundary.
+
+Two chain drivers share the per-sweep semantics:
+
+* ``mine_block`` — the sequential oracle: one sweep at a time, host work
+  strictly between sweeps. This is the reference behavior every other
+  driver must match byte-for-byte.
+* ``mine_chain`` (pipeline on, the default) — the async double-buffered
+  pipeline: sweep N+1 is dispatched through the backend's
+  ``search_async`` seam *speculatively assuming no winner in sweep N*
+  (the next window of this rank's stripe, or the next extra-nonce
+  template when the window set is striped), and on a winner the next
+  BLOCK's first sweep is dispatched from the winner's digest before the
+  C++ append lands — so host winner validation, chain append, the
+  ``on_block`` checkpoint seam, and template rebuilds all overlap device
+  compute instead of serializing with it (ROADMAP item 1:
+  ``bubble_fraction`` -> ~0, measured by meshwatch's ``pipeline_report``
+  and gated by ``make pipeline-smoke``).
+
+The pipeline preserves the determinism contract by construction:
+results are consumed strictly in issue order (ascending windows, then
+ascending templates — the lowest-nonce rule even when a speculative
+window completes out of order), a winner discards every still-queued
+speculative dispatch, and each block boundary re-validates the
+speculated candidate + window set against the C++ node (a re-stripe or
+retarget mismatch discards and re-dispatches). Discarded dispatches are
+stripped of their block identity (``strip_block_identity``) exactly like
+the fused recovery bail-out's abandoned batches, so blocktrace
+waterfalls never merge a dead dispatch's slices into a real block.
+``MPIBT_PIPELINE=0`` (or ``pipeline=False``) selects the sequential
+oracle.
 """
 from __future__ import annotations
 
+import collections
 import dataclasses
+import functools
 import time
 from typing import Callable
 
 from .. import core
-from ..backend import MinerBackend, backend_from_config
+from ..backend import MinerBackend, backend_from_config, sync_search_future
 from ..blocktrace import trace_block
 from ..blocktrace.critical_path import observe_block_metrics
 from ..config import MAX_EXTRA_NONCE, MinerConfig, extend_payload
-from ..meshwatch.pipeline import profiler
+from ..meshwatch.pipeline import profiler, strip_block_identity
 from ..telemetry import counter, heartbeat, histogram
+from ..telemetry.events import emit_event, env_number
 from ..telemetry.spans import span
 from ..utils.logging import block_logger
 
@@ -36,18 +69,113 @@ class BlockRecord:
         return self.hashes_tried / max(self.wall_ms / 1e3, 1e-9)
 
 
+class _WindowSet:
+    """Lazy, index-addressable view of one block's ``search_windows()``.
+
+    ``stripe_windows`` yields millions of slices for a striped rank;
+    the sequential oracle never materializes them (it stops at the
+    first winner) and neither may the pipeline — windows are pulled
+    from the generator only as far as the sweep cursor actually
+    reaches. ``get(i)`` returns the i-th ``(start, end)`` window or
+    None past the end."""
+
+    __slots__ = ("_it", "_cache", "_done")
+
+    def __init__(self, it):
+        self._it = iter(it)
+        self._cache: list[tuple] = []
+        self._done = False
+
+    def get(self, i: int):
+        while not self._done and len(self._cache) <= i:
+            try:
+                self._cache.append(tuple(next(self._it)))
+            except StopIteration:
+                self._done = True
+        return self._cache[i] if i < len(self._cache) else None
+
+    def striped(self) -> bool:
+        """More than one window — the striped-world shape whose
+        cross-template speculation discard costs at most one slice."""
+        return self.get(1) is not None
+
+
+class _SweepDispatch:
+    """One issued sweep of the pipelined driver: its place in the sweep
+    order (height, template, window index), the exact candidate it
+    searched, its future, and its pipeline record. ``t_issue``/``t_done``
+    bracket the host-visible in-flight interval — recorded as the
+    ``device`` pipeline segment at consume (or discard-drain) time so
+    the segment carries the right block identity, or none at all for a
+    discard."""
+
+    __slots__ = ("height", "template", "window_index", "window", "cand",
+                 "future", "prec", "t_issue", "t_done")
+
+    def __init__(self, height: int, template: int, window_index: int,
+                 window: tuple, cand: bytes, prec):
+        self.height = height
+        self.template = template
+        self.window_index = window_index
+        self.window = window
+        self.cand = cand
+        self.prec = prec
+        self.future = None
+        self.t_issue = 0.0
+        self.t_done: float | None = None
+
+    def device_window(self) -> tuple[float, float]:
+        end = self.t_done if self.t_done is not None else self.prec.now()
+        return self.t_issue, max(end, self.t_issue)
+
+
+def _drain_discarded(d: _SweepDispatch, fut) -> None:
+    """Done-callback for a discarded dispatch that had already reached
+    the backend: the sweep ran, so its device window stays visible in
+    the pipeline record — as unattributed work (identity stripped),
+    never merged into the block a live dispatch mines."""
+    if fut.cancelled():
+        return
+    try:
+        fut.result()
+    except BaseException as e:
+        # A discarded dispatch that also FAILED: nothing to account,
+        # but the failure is an event a post-mortem can see.
+        emit_event({"event": "speculative_dispatch_failed",
+                    "error": f"{type(e).__name__}: {e}"})
+        return
+    t0, t1 = d.device_window()
+    d.prec.add_segment("device", t0, t1)
+    # The callback may run inline on the miner thread inside another
+    # block's trace scope — strip AGAIN so the drained segment can never
+    # pick up a foreign height stamp.
+    strip_block_identity(d.prec.record, segments=True)
+
+
 class Miner:
     """One mining node: a C++ Node + a search backend."""
 
+    #: Max dispatches in flight in the pipelined driver: the one being
+    #: waited on plus one speculative successor — double-buffered. Depth
+    #: beyond 2 buys nothing (each sweep's successor is speculative on
+    #: ITS no-winner too) and widens the discard on a winner.
+    PIPELINE_DEPTH = 2
+
     def __init__(self, config: MinerConfig, node_id: int = 0,
                  backend: MinerBackend | None = None,
-                 log_fn: Callable[[dict], None] | None = None):
+                 log_fn: Callable[[dict], None] | None = None,
+                 pipeline: bool | None = None):
         self.config = config
         self.node = core.Node(config.difficulty_bits, node_id)
         self.backend = (backend if backend is not None
                         else backend_from_config(config))
         self.records: list[BlockRecord] = []
         self._log = log_fn if log_fn is not None else block_logger()
+        if pipeline is None:
+            pipeline = bool(env_number("MPIBT_PIPELINE", 1, cast=int,
+                                       minimum=0))
+        self.pipeline = bool(pipeline)
+        self._trace_records: list[dict] = []
 
     def search_windows(self):
         """The ascending ``(start, end)`` nonce windows each candidate
@@ -58,8 +186,25 @@ class Miner:
         rank's re-stripeable share of the space."""
         return ((0, 1 << 32),)
 
+    # ---- per-block hooks ---------------------------------------------------
+
+    def _begin_block(self, height: int) -> None:
+        """Runs BEFORE a block's first consumed sweep, in both drivers —
+        the elastic supervision seam (fault site + staleness oracle +
+        re-stripe). The pipelined driver re-validates any speculative
+        dispatch against the post-hook window set and candidate, so a
+        hook that re-stripes simply turns the speculation into a
+        discard."""
+
+    def _block_mined(self, rec: BlockRecord) -> None:
+        """Runs right after a block's append, in both drivers — the
+        elastic causal-record seam."""
+
+    # ---- the sequential oracle --------------------------------------------
+
     def mine_block(self, data: bytes | None = None) -> BlockRecord:
-        """Mines and appends exactly one block on the current tip.
+        """Mines and appends exactly one block on the current tip — the
+        sequential oracle the pipelined driver must match byte-for-byte.
 
         If the full 2^32 nonce space holds no qualifier, rolls over to a
         fresh space via the shared extra-nonce rule (config.extend_payload)
@@ -72,6 +217,7 @@ class Miner:
         analysis/hotpath_lint.py ENTRY_POINTS or HOT002 fires).
         """
         height = self.node.height + 1
+        self._begin_block(height)
         if data is None:
             data = self.config.payload(height)
         backend = self.backend.name
@@ -154,19 +300,29 @@ class Miner:
                 accepted = self.node.submit(winner)
         if not accepted:
             raise RuntimeError(f"backend returned invalid block at {height}")
+        rec = BlockRecord(height=height, nonce=res.nonce,
+                          hash=res.hash.hex(), wall_ms=wall_ms,
+                          hashes_tried=res.hashes_tried)
+        self._finalize_block(rec, backend)
+        return rec
+
+    def _finalize_block(self, rec: BlockRecord, backend: str) -> None:
+        """Post-append block accounting, shared by BOTH drivers so the
+        two can never drift: counters, heartbeat, latency histogram,
+        the records list, the block_mined log line, and the
+        ``_block_mined`` hook. ``backend`` is the label captured when
+        the block's sweeps were issued (the ladder may have stepped
+        down since)."""
         counter("blocks_mined_total", help="blocks mined and appended",
                 backend=backend).inc()
         heartbeat("miner_heartbeat").set(self.node.height)
         histogram("block_latency_ms",
                   help="wall-clock per mined block (winner latency, ms)",
-                  backend=backend).observe(wall_ms)
-        rec = BlockRecord(height=height, nonce=res.nonce,
-                          hash=res.hash.hex(), wall_ms=wall_ms,
-                          hashes_tried=res.hashes_tried)
+                  backend=backend).observe(rec.wall_ms)
         self.records.append(rec)
         self._log({"event": "block_mined", "backend": self.backend.name,
                    **dataclasses.asdict(rec)})
-        return rec
+        self._block_mined(rec)
 
     def mine_chain(self, n_blocks: int | None = None,
                    on_block: Callable[[BlockRecord], None] | None = None
@@ -175,9 +331,16 @@ class Miner:
 
         ``on_block`` runs after each append — the periodic-checkpoint
         seam (``mine --checkpoint-every N`` saves the chain here, so a
-        SIGKILL mid-run loses at most N blocks; docs/resilience.md).
+        SIGKILL mid-run loses at most N blocks; docs/resilience.md). In
+        the pipelined driver the next block's sweep is already in flight
+        when it runs, which is exactly how checkpoint writes come off
+        the critical path.
+
+        chainlint HOTPATH entry point (with ``mine_block``).
         """
         n = n_blocks if n_blocks is not None else self.config.n_blocks
+        if self.pipeline and n > 0:
+            return self._mine_chain_pipelined(n, on_block)
         records = []
         for _ in range(n):
             rec = self.mine_block()
@@ -197,6 +360,257 @@ class Miner:
             observe_block_metrics(rec.height,
                                   records=self._trace_records)
         return records
+
+    # ---- the async double-buffered pipeline -------------------------------
+
+    def _issue_sweep(self, height: int, template: int,
+                     windows: _WindowSet, w_idx: int,
+                     cand_fn: Callable[[], bytes],
+                     backend_name: str) -> _SweepDispatch:
+        """Issues one sweep through the backend's ``search_async`` seam.
+        The candidate build is the ``enqueue`` segment; the dispatch
+        itself returns immediately and the in-flight interval becomes
+        the ``device`` segment at consume time."""
+        w_start, w_end = windows.get(w_idx)
+        with trace_block(height, template=template):
+            prec = profiler().dispatch(kind="sweep", height=height,
+                                       backend=backend_name)
+            with prec.segment("enqueue"):
+                cand = cand_fn()
+            d = _SweepDispatch(height, template, w_idx, (w_start, w_end),
+                               cand, prec)
+            search_async = getattr(self.backend, "search_async", None)
+            d.t_issue = prec.now()
+            if search_async is not None:
+                fut = search_async(cand, self.config.difficulty_bits,
+                                   start_nonce=w_start,
+                                   max_count=w_end - w_start)
+            else:
+                # Duck-typed backends without the seam (the elastic
+                # device-mesh flavor keeps its guarded collectives
+                # synchronous): the degenerate one-deep pipeline.
+                fut = sync_search_future(self.backend.search, cand,
+                                         self.config.difficulty_bits,
+                                         start_nonce=w_start,
+                                         max_count=w_end - w_start)
+            d.future = fut
+            fut.add_done_callback(
+                lambda _f, d=d, now=prec.now: setattr(d, "t_done", now()))
+        return d
+
+    def _consume(self, d: _SweepDispatch):
+        """Blocks on one dispatch's result (strictly in issue order —
+        the lowest-nonce rule) and records its device window with the
+        dispatch's own block identity."""
+        with span("miner.sweep", height=d.height,
+                  extra_nonce=d.template):
+            res = d.future.result()
+        t0, t1 = d.device_window()
+        with trace_block(d.height, template=d.template):
+            d.prec.add_segment("device", t0, t1)
+        return res
+
+    def _discard_speculative(self, pending, reason: str) -> None:
+        """Discards every still-queued speculative dispatch: a winner
+        (or re-stripe, or error) falsified the assumption they were
+        issued under. Identity is stripped from their pipeline records
+        so blocktrace waterfalls stay honest; a dispatch that already
+        reached the backend drains in the background as unattributed
+        work."""
+        while pending:
+            d = pending.popleft()
+            counter("speculative_discards_total",
+                    help="speculative pipeline dispatches discarded "
+                         "before consumption, by reason",
+                    reason=reason).inc()
+            strip_block_identity(d.prec.record, segments=True)
+            if not d.future.cancel():
+                d.future.add_done_callback(
+                    functools.partial(_drain_discarded, d))
+
+    def _candidate(self, cands: dict, data: bytes, template: int) -> bytes:
+        cand = cands.get(template)
+        if cand is None:
+            cand = cands[template] = self.node.make_candidate(
+                extend_payload(data, template))
+        return cand
+
+    def _speculation_valid(self, pending, windows: _WindowSet,
+                           cands: dict, data: bytes) -> bool:
+        """True when every pending speculative dispatch still matches
+        post-``_begin_block`` reality: same sweep order from (template
+        0, window 0), same (possibly re-striped) windows, and a
+        candidate byte-identical to what the C++ node builds on the
+        real tip (covers retarget bits and any submit-path drift)."""
+        expect = (0, 0)
+        for d in pending:
+            if (d.template, d.window_index) != expect:
+                return False
+            if d.window != windows.get(d.window_index):
+                return False
+            if d.cand != self._candidate(cands, data, d.template):
+                return False
+            expect = ((d.template, d.window_index + 1)
+                      if windows.get(d.window_index + 1) is not None
+                      else (d.template + 1, 0))
+        return True
+
+    def _mine_chain_pipelined(self, n: int, on_block) -> list[BlockRecord]:
+        """The double-buffered chain driver (module docstring): at most
+        ``PIPELINE_DEPTH`` sweeps in flight, consumed strictly in issue
+        order; host work for block N overlaps the already-dispatched
+        sweep of block N+1."""
+        backend = self.backend.name
+        records: list[BlockRecord] = []
+        pending: collections.deque[_SweepDispatch] = collections.deque()
+        t_prev = time.perf_counter()
+        try:
+            while len(records) < n:
+                rec, pending = self._pipeline_block(
+                    n - len(records), pending, backend)
+                wall_ms = (time.perf_counter() - t_prev) * 1e3
+                t_prev = time.perf_counter()
+                rec = dataclasses.replace(rec, wall_ms=wall_ms)
+                self._finalize_block(rec, backend)
+                records.append(rec)
+                if on_block is not None:
+                    # In-scope of the block's trace: the periodic
+                    # checkpoint save's pipeline segment joins the block
+                    # that paid it — while the NEXT block's sweep is
+                    # already in flight underneath it.
+                    with trace_block(rec.height):
+                        on_block(rec)
+                observe_block_metrics(rec.height,
+                                      records=self._trace_records)
+        except BaseException:
+            # Any failure (exhausted retries, invalid block, hook
+            # error): the still-queued speculation must not leave block
+            # identities on records of work that will be re-issued.
+            self._discard_speculative(pending, "error")
+            raise
+        return records
+
+    def _pipeline_block(self, blocks_left: int, pending, backend: str):
+        """Mines ONE block through the pipeline; returns ``(record,
+        pending)`` where ``pending`` (the chain driver's own deque,
+        threaded through every block so its error handler always covers
+        what is in flight) holds the speculative first sweep of the
+        next block — dispatched from this winner's digest BEFORE the
+        append, the overlap that closes the bubble. ``wall_ms`` in the
+        returned record is a placeholder the chain driver replaces with
+        the marginal per-block wall."""
+        height = self.node.height + 1
+        self._begin_block(height)
+        data = self.config.payload(height)
+        windows = _WindowSet(self.search_windows())
+        if windows.get(0) is None:
+            self._discard_speculative(pending, "error")
+            raise RuntimeError("search_windows yielded no nonce windows")
+        cands: dict[int, bytes] = {}
+        if pending and not self._speculation_valid(pending, windows,
+                                                   cands, data):
+            # The world changed under the speculation (re-stripe after
+            # an eviction, a retarget stepping bits, a hook moving the
+            # tip): discard and re-dispatch on the fresh reality.
+            self._discard_speculative(pending, "restripe")
+        # The sweep cursor: the (template, window) the NEXT issued
+        # dispatch covers. None = blocked at a template boundary a
+        # 1-window world must cross reactively (speculating a fresh
+        # full-space template would cost a whole discarded sweep; a
+        # striped world's cross-template discard costs at most one
+        # window slice, so it MAY speculate).
+        def advance(template: int, w_idx: int):
+            if windows.get(w_idx + 1) is not None:
+                return (template, w_idx + 1)
+            if windows.striped() and template < MAX_EXTRA_NONCE:
+                return (template + 1, 0)
+            return None
+
+        cursor = ((0, 0) if not pending
+                  else advance(pending[-1].template,
+                               pending[-1].window_index))
+        self._trace_records = trace_records = [d.prec.record
+                                               for d in pending]
+        tried = 0
+        res = None
+        win_d = None
+        with trace_block(height), span("miner.block", height=height):
+            while True:
+                while cursor is not None and \
+                        len(pending) < self.PIPELINE_DEPTH:
+                    e, w = cursor
+                    d = self._issue_sweep(
+                        height, e, windows, w,
+                        lambda e=e: self._candidate(cands, data, e),
+                        backend)
+                    pending.append(d)
+                    trace_records.append(d.prec.record)
+                    cursor = advance(e, w)
+                d = pending.popleft()
+                r = self._consume(d)
+                counter("mining_rounds_total",
+                        help="backend sweep rounds issued",
+                        backend=backend).inc()
+                counter("hashes_tried_total",
+                        help="nonces evaluated across all sweeps",
+                        backend=backend).inc(r.hashes_tried)
+                tried += r.hashes_tried
+                heartbeat("miner_heartbeat").set(self.node.height)
+                if r.nonce is not None:
+                    res, win_d = r, d
+                    break
+                if windows.get(d.window_index + 1) is None:
+                    # This template's whole window set came back empty:
+                    # the shared rollover rule (config.extend_payload).
+                    self._log({"event": "nonce_space_exhausted",
+                               "height": height,
+                               "extra_nonce": d.template + 1})
+                    if d.template >= MAX_EXTRA_NONCE:
+                        self._discard_speculative(pending, "error")
+                        raise RuntimeError(
+                            f"{MAX_EXTRA_NONCE} consecutive empty nonce "
+                            f"spaces at height {height} — difficulty "
+                            f"{self.config.difficulty_bits} is "
+                            f"unsatisfiably high")
+                    if cursor is None and not pending:
+                        # Reactive rollover: the no-winner is CONFIRMED
+                        # now, so the next template is no longer a
+                        # speculation.
+                        cursor = (d.template + 1, 0)
+            res = dataclasses.replace(res, hashes_tried=tried)
+            # A winner falsifies every queued no-winner speculation.
+            self._discard_speculative(pending, "winner")
+            if blocks_left > 1:
+                # Dispatch the next block's first sweep from the
+                # winner's digest — the prev_hash the C++ append is
+                # about to install — so validate/append/checkpoint below
+                # overlap device compute. Re-validated (and discarded on
+                # mismatch) at the next block boundary. It rides the
+                # SAME deque the chain driver's error handler discards,
+                # so an exception anywhere between here and the next
+                # block boundary (a submit failure, an on_block error)
+                # can never orphan it with its height stamps intact.
+                nh, ndata = height + 1, self.config.payload(height + 1)
+                nd = self._issue_sweep(
+                    nh, 0, windows, 0,
+                    lambda: core.make_candidate_header(
+                        res.hash, ndata, nh, self.config.difficulty_bits),
+                    backend)
+                pending.append(nd)
+                trace_records.append(nd.prec.record)
+            with win_d.prec.segment("validate"):
+                winner = core.set_nonce(win_d.cand, res.nonce)
+            with span("miner.append", height=height), \
+                    win_d.prec.segment("append"):
+                accepted = self.node.submit(winner)
+        if not accepted:
+            self._discard_speculative(pending, "error")
+            raise RuntimeError(f"backend returned invalid block at "
+                               f"{height}")
+        rec = BlockRecord(height=height, nonce=res.nonce,
+                          hash=res.hash.hex(), wall_ms=0.0,
+                          hashes_tried=res.hashes_tried)
+        return rec, pending
 
     # ---- aggregate metrics -------------------------------------------------
 

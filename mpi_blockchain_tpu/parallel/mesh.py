@@ -112,7 +112,7 @@ def make_miner_mesh(n_miners: int) -> Mesh:
 
 
 def maybe_shard_over_miners(fn, n_miners: int, mesh: Mesh | None,
-                            n_out: int):
+                            n_out: int, donate_argnames: tuple = ()):
     """jit-wraps a device program, shard_map'd over ('miners',) when
     n_miners > 1 OR an explicit mesh is passed — 1-element-axis collectives
     compile the same program, which is how the production sharded path gets
@@ -120,7 +120,14 @@ def maybe_shard_over_miners(fn, n_miners: int, mesh: Mesh | None,
     fn must accept an `axis_name` parameter (None = unsharded); its other
     parameters are the device inputs — in_specs arity is derived from the
     signature so callers cannot hand-miscount it. All inputs and the n_out
-    outputs are replicated."""
+    outputs are replicated.
+
+    ``donate_argnames`` names parameters of ``fn`` whose buffers are
+    DONATED to the dispatch (the double-buffer pipeline handoff: the
+    fused miner threads its tip words output -> input across pipelined
+    calls). Names are resolved against ``fn``'s own signature and passed
+    to ``jax.jit`` as positions — the shard_map wrapper's signature is
+    opaque to jit's own name resolution."""
     import functools
     import inspect
     params = [p.name for p in inspect.signature(fn).parameters.values()]
@@ -129,6 +136,12 @@ def maybe_shard_over_miners(fn, n_miners: int, mesh: Mesh | None,
             f"shardable device fn {getattr(fn, '__name__', fn)!r} must "
             f"take an axis_name parameter; has {params}")
     n_in = len(params) - 1
+    unknown = [n for n in donate_argnames if n not in params[:n_in]]
+    if unknown:
+        raise ConfigError(
+            f"donate_argnames {unknown} not among the device inputs "
+            f"{params[:n_in]} of {getattr(fn, '__name__', fn)!r}")
+    donate = tuple(params.index(n) for n in donate_argnames)
     if n_miners > 1 or mesh is not None:
         if mesh is None:
             mesh = make_miner_mesh(n_miners)
@@ -142,8 +155,9 @@ def maybe_shard_over_miners(fn, n_miners: int, mesh: Mesh | None,
         sharded = shard_map(functools.partial(fn, axis_name="miners"),
                             mesh=mesh, in_specs=(P(),) * n_in,
                             out_specs=(P(),) * n_out)
-        return jax.jit(sharded)
-    return jax.jit(functools.partial(fn, axis_name=None))
+        return jax.jit(sharded, donate_argnums=donate)
+    return jax.jit(functools.partial(fn, axis_name=None),
+                   donate_argnums=donate)
 
 
 def make_round_search(sweep, batch_size: int, round_size: int):

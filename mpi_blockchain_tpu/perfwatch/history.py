@@ -27,6 +27,12 @@ wall-clock, lower is better):
                     ABSOLUTE bound instead: detector.SECTION_BOUNDS caps
                     it at 3%, the telemetry observer-effect budget
                     (blocktrace/overhead.py)
+    pipeline_bubble bubble_fraction of the pipelined miner's fixed-seed
+                    instrumented mine — SECTION_BOUNDS caps it at 0.15
+                    (ROADMAP item 1 acceptance; the payload also carries
+                    bubble_fraction_sequential, the before number from
+                    the same-seed sequential oracle leg, for the
+                    before/after record; meshwatch/bubble.py)
 
 Seeding: ``seed_from_bench_rounds`` imports the repo's existing
 ``BENCH_r0*.json`` round records (fresh measurements only — ``cached``
@@ -57,6 +63,7 @@ SECTION_METRICS: dict[str, tuple[str, str | None]] = {
     "utilization": ("vpu_utilization_pct", None),
     "trace_overhead": ("overhead_pct", None),
     "trace_block_observe": ("block_observe_us", None),
+    "pipeline_bubble": ("bubble_fraction", None),
 }
 
 _KEY_FIELDS = ("preset", "kernel", "mesh", "backend")

@@ -21,6 +21,8 @@ step down.
 """
 from __future__ import annotations
 
+import concurrent.futures
+import threading
 from typing import Callable
 
 from .. import core
@@ -90,6 +92,14 @@ class ResilientBackend(MinerBackend):
         self._policy = policy
         self._seed = seed
         self.degradations: list[dict] = []
+        # Single-flight discipline for the async pipeline: every search
+        # (sync caller or dispatch worker) runs under this lock, so the
+        # ladder state (_i/_backend) is only ever stepped by ONE dispatch
+        # at a time — a speculative dispatch that exhausts its rung
+        # degrades the ladder exactly once, and the next dispatch starts
+        # on the surviving rung instead of racing a half-rebuilt one.
+        self._lock = threading.RLock()
+        self._worker: concurrent.futures.ThreadPoolExecutor | None = None
 
     # ---- introspection ---------------------------------------------------
 
@@ -114,18 +124,42 @@ class ResilientBackend(MinerBackend):
     def search(self, header80: bytes, difficulty_bits: int,
                start_nonce: int = 0,
                max_count: int = 1 << 32) -> SearchResult:
-        while True:
-            label = self.rung
-            try:
-                return call_with_retry(
-                    lambda: self._checked_search(header80, difficulty_bits,
-                                                 start_nonce, max_count),
-                    site=f"dispatch.{label}",
-                    policy=(self._policy if self._policy is not None
-                            else policy_for("dispatch", seed=self._seed)))
-            except RetryExhausted as e:
-                if not self._step_down(e):
-                    raise
+        with self._lock:
+            while True:
+                label = self.rung
+                try:
+                    return call_with_retry(
+                        lambda: self._checked_search(header80,
+                                                     difficulty_bits,
+                                                     start_nonce,
+                                                     max_count),
+                        site=f"dispatch.{label}",
+                        policy=(self._policy if self._policy is not None
+                                else policy_for("dispatch",
+                                                seed=self._seed)))
+                except RetryExhausted as e:
+                    if not self._step_down(e):
+                        raise
+
+    def search_async(self, header80: bytes, difficulty_bits: int,
+                     start_nonce: int = 0,
+                     max_count: int = 1 << 32
+                     ) -> "concurrent.futures.Future":
+        """The real async dispatch seam: submits the FULL resilient
+        search (retry budget, host-side re-validation, ladder
+        step-down) to the backend's one dispatch worker. One worker =
+        FIFO completion AND single-flight degradation: a speculative
+        dispatch retries/degrades to completion before the next
+        dispatch starts, so it can never poison an in-flight one — the
+        ladder the survivor lands on is simply the ladder every later
+        dispatch (speculative or not) inherits."""
+        with self._lock:
+            if self._worker is None:
+                self._worker = concurrent.futures.ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="dispatch-worker")
+            worker = self._worker
+        return worker.submit(self.search, header80, difficulty_bits,
+                             start_nonce=start_nonce, max_count=max_count)
 
     def _checked_search(self, header80: bytes, difficulty_bits: int,
                         start_nonce: int, max_count: int) -> SearchResult:

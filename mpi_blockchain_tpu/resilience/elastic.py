@@ -473,18 +473,23 @@ class ElasticMiner(Miner):
     def search_windows(self):
         return self.world.stripe_windows(self.config.batch_size)
 
-    def mine_block(self, data: bytes | None = None):
+    def _begin_block(self, height: int) -> None:
         # One supervision step (fault site + staleness oracle + any
-        # resulting re-stripe) before every block's sweep — hooking here
-        # rather than overriding mine_chain keeps the base loop (and any
-        # future change to it) as the single mining driver.
-        self.world.step(self.node.height + 1)
-        rec = super().mine_block(data)
+        # resulting re-stripe) before every block's first consumed sweep
+        # — the base drivers' per-block hook, so BOTH the sequential
+        # oracle and the pipelined driver supervise identically. In the
+        # pipelined driver a re-stripe here invalidates the in-flight
+        # speculative dispatch (its windows were the dead world's), and
+        # the driver discards + re-dispatches on the shrunken stripes —
+        # a dead dispatch's slices are never merged into a re-mined
+        # height.
+        self.world.step(height)
+
+    def _block_mined(self, rec) -> None:
         # Causal record per block: deterministic fields only (height,
         # nonce, hash prefix) — the dump-determinism contract.
         self.world.log.record("mine", step=rec.height, height=rec.height,
                               nonce=rec.nonce, hash=rec.hash[:16])
-        return rec
 
 
 # ---- the in-process device-mesh flavor -------------------------------------

@@ -120,7 +120,7 @@ def test_fused_rollover_identical(rollover_oracle):
         cfg, blocks_per_call=1,
         recovery_backend=ExhaustFirstSpace(get_backend("cpu"), cfg),
         log_fn=lambda d: None)
-    fm._fns[1] = make_fused_miner(1, cfg.batch_pow2, DIFF, kernel="jnp",
+    fm._fns[(1, True)] = make_fused_miner(1, cfg.batch_pow2, DIFF, kernel="jnp",
                                   max_rounds=2)
     fm.mine_chain()
     assert fm.chain_hashes() == rollover_oracle.chain_hashes()
@@ -143,7 +143,7 @@ def test_fused_missed_nonce_is_kernel_bug_not_rollover():
     else:
         pytest.fail("staging broken: no prefix with winner beyond cap")
     fm = FusedMiner(cfg, blocks_per_call=1, log_fn=lambda d: None)
-    fm._fns[1] = make_fused_miner(1, cfg.batch_pow2, DIFF, kernel="jnp",
+    fm._fns[(1, True)] = make_fused_miner(1, cfg.batch_pow2, DIFF, kernel="jnp",
                                   max_rounds=1)
     with pytest.raises(RuntimeError, match="kernel bug"):
         fm.mine_chain()

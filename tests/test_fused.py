@@ -142,7 +142,7 @@ def test_pipeline_dispatch_accounting_and_recovery_discard():
         fn = capped if int(h) == 0 else real
         return fn(prev, data, h)
 
-    fm._fns[1] = spy
+    fm._fns[(1, True)] = spy
     fm.mine_chain()
     assert fm.node.height == 4
     # The first span fills the in-flight window in height order, the

@@ -1,0 +1,503 @@
+"""Async double-buffered dispatch pipeline (ROADMAP item 1).
+
+Correctness edges of the pipelined ``Miner.mine_chain`` driver: same-seed
+byte-identity with the sequential oracle, strict issue-order consumption
+(the lowest-nonce rule under out-of-order future completion), winner /
+re-stripe / error discards with stripped block identity, the resilient
+ladder's single-flight behavior on the async seam, SIGKILL-mid-overlap
+recovery, and the pipeline_bubble bench wiring.
+"""
+from __future__ import annotations
+
+import concurrent.futures
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from mpi_blockchain_tpu import core, telemetry
+from mpi_blockchain_tpu.backend import SearchResult, backend_from_config
+from mpi_blockchain_tpu.backend.cpu import CpuBackend
+from mpi_blockchain_tpu.config import ConfigError, MinerConfig
+from mpi_blockchain_tpu.meshwatch.pipeline import (pipeline_report,
+                                                   profiler,
+                                                   reset_profiler,
+                                                   strip_block_identity)
+from mpi_blockchain_tpu.models.miner import Miner
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    telemetry.reset()
+    reset_profiler()
+    yield
+    telemetry.reset()
+    reset_profiler()
+
+
+def _quiet(cfg, **kw) -> Miner:
+    return Miner(cfg, log_fn=lambda rec: None, **kw)
+
+
+# ---- byte-identity with the sequential oracle ----------------------------
+
+
+@pytest.mark.parametrize("difficulty,blocks,prefix", [
+    (10, 5, "block"),
+    (12, 4, "pipeline"),
+    (9, 6, "sweep"),
+])
+def test_pipelined_chain_byte_identical_to_sequential_oracle(
+        difficulty, blocks, prefix):
+    """The acceptance determinism edge, across >= 3 seeds (the payload
+    prefix IS the seed: winner nonces are a pure function of it)."""
+    cfg = MinerConfig(difficulty_bits=difficulty, n_blocks=blocks,
+                      backend="cpu", data_prefix=prefix)
+    seq = _quiet(cfg, pipeline=False)
+    seq.mine_chain()
+    pip = _quiet(cfg, pipeline=True)
+    pip.mine_chain()
+    assert pip.chain_hashes() == seq.chain_hashes()
+    assert [r.nonce for r in pip.records] == \
+        [r.nonce for r in seq.records]
+    # Per-block accounting matches too: the pipeline consumes exactly
+    # the sweeps the oracle runs (discards are never counted in).
+    assert [r.hashes_tried for r in pip.records] == \
+        [r.hashes_tried for r in seq.records]
+
+
+def test_default_miner_pipeline_no_discards_no_extra_rounds():
+    """The default (1-window) miner speculates only across block
+    boundaries from the winner digest — never a rollover template — so
+    its backend call sequence is IDENTICAL to the oracle's."""
+    cfg = MinerConfig(difficulty_bits=10, n_blocks=4, backend="cpu")
+    _quiet(cfg, pipeline=False).mine_chain()
+    seq_rounds = telemetry.counter("mining_rounds_total",
+                                   backend="cpu").value
+    telemetry.reset()
+    _quiet(cfg, pipeline=True).mine_chain()
+    assert telemetry.counter("mining_rounds_total",
+                             backend="cpu").value == seq_rounds
+    assert telemetry.counter("speculative_discards_total",
+                             reason="winner").value == 0
+
+
+def test_env_knob_selects_sequential(monkeypatch):
+    monkeypatch.setenv("MPIBT_PIPELINE", "0")
+    assert Miner(MinerConfig(backend="cpu")).pipeline is False
+    monkeypatch.delenv("MPIBT_PIPELINE")
+    assert Miner(MinerConfig(backend="cpu")).pipeline is True
+
+
+def test_make_candidate_header_matches_cpp_builder():
+    """The speculative candidate twin must be byte-identical to
+    Node::make_candidate on every height it speculates for."""
+    cfg = MinerConfig(difficulty_bits=8, n_blocks=3, backend="cpu")
+    m = _quiet(cfg, pipeline=False)
+    for _ in range(3):
+        h = m.node.height + 1
+        data = cfg.payload(h)
+        assert core.make_candidate_header(
+            m.node.tip_hash, data, h, cfg.difficulty_bits) == \
+            m.node.make_candidate(data)
+        m.mine_block()
+
+
+# ---- issue-order consumption (lowest-nonce under async) ------------------
+
+
+class _StripedMiner(Miner):
+    """A miner whose sweep is chopped into ascending windows — the
+    elastic shape, without a world."""
+
+    WINDOWS = ((0, 1 << 12), (1 << 12, 1 << 13), (1 << 13, 1 << 32))
+
+    def search_windows(self):
+        return self.WINDOWS
+
+
+class _OutOfOrderBackend(CpuBackend):
+    """Real CPU search, but the FIRST window's future completes LAST:
+    the adversarial completion order for the lowest-nonce rule."""
+
+    def __init__(self):
+        super().__init__()
+        self.completions: list[int] = []
+
+    def search_async(self, header80, difficulty_bits, start_nonce=0,
+                     max_count=1 << 32):
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+
+        def run():
+            if start_nonce == 0:
+                time.sleep(0.15)     # windows after this one finish first
+            try:
+                res = self.search(header80, difficulty_bits,
+                                  start_nonce=start_nonce,
+                                  max_count=max_count)
+            except BaseException as e:
+                fut.set_exception(e)
+                return
+            self.completions.append(start_nonce)
+            fut.set_result(res)
+
+        threading.Thread(target=run, daemon=True).start()
+        return fut
+
+
+def test_lowest_nonce_rule_survives_out_of_order_completion():
+    """A speculative later window completing before window 0 must not
+    win: results are consumed strictly in issue order."""
+    cfg = MinerConfig(difficulty_bits=8, n_blocks=1, backend="cpu")
+    oracle = _StripedMiner(cfg, backend=CpuBackend(),
+                           log_fn=lambda r: None, pipeline=False)
+    oracle.mine_chain()
+    backend = _OutOfOrderBackend()
+    m = _StripedMiner(cfg, backend=backend, log_fn=lambda r: None,
+                      pipeline=True)
+    m.mine_chain()
+    assert m.chain_hashes() == oracle.chain_hashes()
+    assert m.records[0].nonce == oracle.records[0].nonce
+    # The adversarial order actually happened: a later window finished
+    # before window 0 did.
+    assert backend.completions and backend.completions[0] != 0
+
+
+# ---- discards -------------------------------------------------------------
+
+
+def test_winner_discards_speculation_and_strips_identity():
+    """A winner in window w falsifies the queued window w+1 dispatch:
+    it is discarded, counted, and its record loses ALL block identity
+    so the critical-path join cannot merge it into the real block."""
+    from mpi_blockchain_tpu.blocktrace.critical_path import (
+        critical_path_report)
+
+    cfg = MinerConfig(difficulty_bits=8, n_blocks=2, backend="cpu")
+    m = _StripedMiner(cfg, backend=CpuBackend(), log_fn=lambda r: None,
+                      pipeline=True)
+    m.mine_chain()
+    discards = telemetry.counter("speculative_discards_total",
+                                 reason="winner").value
+    assert discards >= 1
+    records = profiler().records()
+    stripped = [r for r in records
+                if r["meta"].get("kind") == "sweep"
+                and "height" not in r["meta"]]
+    assert len(stripped) >= 1
+    for r in stripped:
+        assert all("height" not in s and "template" not in s
+                   for s in r["segments"])
+    # The mined blocks' waterfalls stay complete and honest.
+    report = critical_path_report(records)
+    assert report["heights"] == [1, 2]
+    for h in report["heights"]:
+        assert report["blocks"][str(h)]["complete"], \
+            report["blocks"][str(h)]
+    # Chain still the oracle's.
+    oracle = _StripedMiner(cfg, backend=CpuBackend(),
+                           log_fn=lambda r: None, pipeline=False)
+    oracle.mine_chain()
+    assert m.chain_hashes() == oracle.chain_hashes()
+
+
+def test_restripe_between_blocks_discards_stale_speculation():
+    """The elastic eviction edge: a window-set change at the block
+    boundary (re-stripe after a rank death) invalidates the in-flight
+    speculative dispatch — it is discarded (reason=restripe) and
+    re-dispatched on the fresh stripes, and the re-mined height's chain
+    is exactly what a sequential miner over the same schedule mines."""
+
+    class EvictingMiner(_StripedMiner):
+        #: windows shrink from block 2 on — the re-striped world.
+        NARROW = ((0, 1 << 11), (1 << 11, 1 << 32))
+
+        def search_windows(self):
+            return (self.NARROW if self.node.height + 1 >= 2
+                    else self.WINDOWS)
+
+    cfg = MinerConfig(difficulty_bits=8, n_blocks=3, backend="cpu")
+    oracle = EvictingMiner(cfg, backend=CpuBackend(),
+                           log_fn=lambda r: None, pipeline=False)
+    oracle.mine_chain()
+    m = EvictingMiner(cfg, backend=CpuBackend(), log_fn=lambda r: None,
+                      pipeline=True)
+    m.mine_chain()
+    assert m.chain_hashes() == oracle.chain_hashes()
+    assert telemetry.counter("speculative_discards_total",
+                             reason="restripe").value >= 1
+
+
+def test_elastic_rank_death_during_speculative_dispatch():
+    """A real ElasticWorld eviction mid-run: the speculative dispatch
+    issued under the 2-rank striping is discarded when the supervisor
+    evicts rank 1 at the block-2 boundary, the re-striped sweep mines
+    on, and no dead-dispatch slice joins a re-mined height's
+    waterfall."""
+    from mpi_blockchain_tpu.blocktrace.critical_path import (
+        critical_path_report)
+    from mpi_blockchain_tpu.resilience.elastic import (ElasticMiner,
+                                                       ElasticWorld)
+
+    class DeathAtHeight2(ElasticMiner):
+        def _begin_block(self, height):
+            if height == 2:
+                self.world.evict(1, "rank_death", height)
+            super()._begin_block(height)
+
+    cfg = MinerConfig(difficulty_bits=9, n_blocks=3, backend="cpu",
+                      batch_pow2=8)
+    m = DeathAtHeight2(cfg, ElasticWorld(2, 0), log_fn=lambda r: None)
+    m.pipeline = True
+    m.mine_chain()
+    seq = DeathAtHeight2(cfg, ElasticWorld(2, 0), log_fn=lambda r: None)
+    seq.pipeline = False
+    seq.mine_chain()
+    assert m.chain_hashes() == seq.chain_hashes()
+    assert m.world.live == [0]
+    assert telemetry.counter("speculative_discards_total",
+                             reason="restripe").value >= 1
+    report = critical_path_report(profiler().records())
+    # Both legs' records are in the ring; every mined height must still
+    # conserve (no foreign slices merged in).
+    for h in report["heights"]:
+        b = report["blocks"][str(h)]
+        total = sum(b["stages_ms"].values()) + b["gap_ms"]
+        # Report fields are rounded to 4 decimals independently.
+        assert total == pytest.approx(b["wall_ms"], abs=1e-2)
+
+
+def test_error_in_flight_discards_pending_and_propagates():
+    class FailingFirstWindow(CpuBackend):
+        def search(self, header80, difficulty_bits, start_nonce=0,
+                   max_count=1 << 32):
+            if start_nonce == 0:
+                raise RuntimeError("dead device")
+            return super().search(header80, difficulty_bits,
+                                  start_nonce=start_nonce,
+                                  max_count=max_count)
+
+    cfg = MinerConfig(difficulty_bits=8, n_blocks=1, backend="cpu")
+    m = _StripedMiner(cfg, backend=FailingFirstWindow(),
+                      log_fn=lambda r: None, pipeline=True)
+    with pytest.raises(RuntimeError, match="dead device"):
+        m.mine_chain()
+    assert telemetry.counter("speculative_discards_total",
+                             reason="error").value >= 1
+    # Every discarded record lost its block identity.
+    for r in profiler().records():
+        if r["meta"].get("kind") == "sweep" and "height" not in r["meta"]:
+            assert all("height" not in s for s in r["segments"])
+
+
+# ---- the resilient ladder on the async seam ------------------------------
+
+
+def test_resilient_async_dispatch_degrades_single_flight():
+    """A speculative dispatch whose rung dies retries/degrades on the
+    dispatch worker WITHOUT poisoning any other dispatch: the ladder
+    steps down exactly once and the chain equals the oracle's."""
+    from mpi_blockchain_tpu.resilience.dispatch import ResilientBackend
+    from mpi_blockchain_tpu.resilience.policy import RetryPolicy
+
+    calls = {"dead": 0}
+
+    class DeadBackend(CpuBackend):
+        name = "dead"
+
+        def search(self, *a, **kw):
+            calls["dead"] += 1
+            raise RuntimeError("rung is dead")
+
+    ladder = ResilientBackend(
+        [("dead", DeadBackend), ("cpu", CpuBackend)],
+        policy=RetryPolicy(max_attempts=2, base_backoff_s=0.0,
+                           max_backoff_s=0.0))
+    cfg = MinerConfig(difficulty_bits=10, n_blocks=3, backend="cpu")
+    m = _quiet(cfg, backend=ladder, pipeline=True)
+    m.mine_chain()
+    oracle = _quiet(cfg, pipeline=False)
+    oracle.mine_chain()
+    assert m.chain_hashes() == oracle.chain_hashes()
+    assert ladder.degraded and ladder.rung == "cpu"
+    # The dead rung was exhausted exactly once (one dispatch's retry
+    # budget), not once per speculative dispatch.
+    assert calls["dead"] == 2
+
+
+def test_resilient_search_async_fifo_completion():
+    be = backend_from_config(MinerConfig(difficulty_bits=8,
+                                         backend="cpu"))
+    node = core.Node(8, 0)
+    cand = node.make_candidate(b"x")
+    futs = [be.search_async(cand, 8, start_nonce=i * 4096,
+                            max_count=4096) for i in range(4)]
+    results = [f.result() for f in futs]
+    assert all(isinstance(r, SearchResult) for r in results)
+    # Deterministic per-window results, regardless of async plumbing.
+    direct = [be.search(cand, 8, start_nonce=i * 4096, max_count=4096)
+              for i in range(4)]
+    assert results == direct
+
+
+# ---- overlap actually happens --------------------------------------------
+
+
+def test_checkpoint_seam_overlaps_next_sweep():
+    """The point of the whole refactor: host work in on_block runs
+    while the next block's dispatch is in flight — the pipeline report
+    must see overlapped host time, and the sequential oracle must
+    not."""
+    overlaps = {}
+    for pipeline in (False, True):
+        reset_profiler()
+
+        def on_block(rec):
+            with profiler().segment_on_last("checkpoint"):
+                time.sleep(0.01)     # stand-in for the checkpoint write
+
+        cfg = MinerConfig(difficulty_bits=13, n_blocks=4, backend="cpu",
+                          data_prefix="sweep")
+        _quiet(cfg, pipeline=pipeline).mine_chain(on_block=on_block)
+        overlaps[pipeline] = pipeline_report()
+    assert overlaps[True]["host_overlapped_fraction"] > 0.3
+    assert overlaps[True]["bubble_fraction"] < \
+        overlaps[False]["bubble_fraction"]
+
+
+def test_live_block_metrics_see_checkpoint_stage_mid_overlap():
+    """PR 10's contract survives the pipeline: the checkpoint segment
+    lands on the (speculative) newest record but is stamped with the
+    block that paid it, so the live per-block observation still counts
+    a checkpoint stage for every block."""
+
+    def on_block(rec):
+        with profiler().segment_on_last("checkpoint"):
+            time.sleep(0.002)
+
+    cfg = MinerConfig(difficulty_bits=12, n_blocks=3, backend="cpu")
+    _quiet(cfg, pipeline=True).mine_chain(on_block=on_block)
+    hist = telemetry.histogram("block_critical_path_ms",
+                               stage="checkpoint")
+    assert hist.count == 3
+
+
+# ---- strip_block_identity shared helper ----------------------------------
+
+
+def test_strip_block_identity_rebinds_and_guards():
+    rec = {"dispatch": 1, "rank": 0,
+           "meta": {"kind": "sweep", "height": 7},
+           "segments": [{"stage": "enqueue", "t0": 1.0, "t1": 2.0,
+                         "height": 7, "template": 1}]}
+    old_meta, old_segs = rec["meta"], rec["segments"]
+    strip_block_identity(rec, segments=True)
+    assert rec["meta"] == {"kind": "sweep"}
+    assert rec["segments"] == [{"stage": "enqueue", "t0": 1.0,
+                                "t1": 2.0}]
+    # Rebound, never mutated (the shard-flusher concurrency contract).
+    assert old_meta == {"kind": "sweep", "height": 7}
+    assert old_segs[0]["height"] == 7
+    # keep_k: the fused partial-batch form keeps height, clamps k.
+    rec2 = {"meta": {"height": 4, "k": 8}, "segments": []}
+    strip_block_identity(rec2, keep_k=3)
+    assert rec2["meta"] == {"height": 4, "k": 3}
+    # Identity-free records pass through untouched.
+    null = {"meta": {}, "segments": []}
+    strip_block_identity(null, segments=True)
+    assert null == {"meta": {}, "segments": []}
+
+
+# ---- pipeline_bubble bench wiring ----------------------------------------
+
+
+def test_pipeline_bubble_payload_and_absolute_bound():
+    from mpi_blockchain_tpu.meshwatch.bubble import measure_pipeline_bubble
+    from mpi_blockchain_tpu.perfwatch.detector import (SECTION_BOUNDS,
+                                                       check_candidate)
+    from mpi_blockchain_tpu.perfwatch.history import (SECTION_METRICS,
+                                                      HistoryStore)
+
+    assert SECTION_METRICS["pipeline_bubble"] == ("bubble_fraction", None)
+    assert SECTION_BOUNDS["pipeline_bubble"] == 0.15
+    payload = measure_pipeline_bubble(difficulty=10, blocks=3)
+    for key in ("bubble_fraction", "bubble_fraction_sequential",
+                "host_overlapped_fraction", "device_dominant_blocks",
+                "chain_identical"):
+        assert key in payload, key
+    assert payload["chain_identical"] is True
+    assert payload["blocks"] == 3
+    finding = check_candidate(HistoryStore("/nonexistent-history.jsonl"),
+                              "pipeline_bubble", payload)
+    assert finding.basis == "absolute-bound"
+    assert finding.allowed_pct == 0.15
+
+
+def test_repo_history_has_pipeline_bubble_record():
+    """The committed before/after record (the satellite's artifact)."""
+    from mpi_blockchain_tpu.perfwatch.history import HistoryStore
+
+    store = HistoryStore(os.path.join(REPO, "PERF_HISTORY.jsonl"))
+    entries = store.entries("pipeline_bubble")
+    assert entries, "PERF_HISTORY.jsonl must carry the pipeline_bubble " \
+                    "before/after record"
+    payload = entries[-1].payload
+    assert payload["bubble_fraction"] <= 0.15
+    assert payload["bubble_fraction_sequential"] > \
+        payload["bubble_fraction"]
+    assert payload["chain_identical"] is True
+
+
+# ---- SIGKILL mid-overlap --------------------------------------------------
+
+
+def test_sigkill_mid_overlap_resumes_with_bounded_loss(tmp_path):
+    """The crash-recovery edge of the overlapped checkpoint seam: a
+    SIGKILL while sweep N+1 is in flight and block N's checkpoint just
+    landed loses at most --checkpoint-every blocks, and the resumed
+    chain verifies and extends."""
+    from mpi_blockchain_tpu.cli import main
+
+    ck = tmp_path / "ck.bin"
+    env = dict(os.environ, JAX_PLATFORMS="cpu", MPIBT_PIPELINE="1",
+               PYTHONPATH=os.pathsep.join(
+                   p for p in (REPO, os.environ.get("PYTHONPATH")) if p))
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "mpi_blockchain_tpu", "mine",
+         "--difficulty", "10", "--blocks", "4000", "--backend", "cpu",
+         "--checkpoint", str(ck), "--checkpoint-every", "2",
+         "--verbose"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, cwd=str(tmp_path))
+    mined = 0
+    for line in proc.stdout:
+        if '"block_mined"' in line:
+            mined += 1
+            if mined >= 5:
+                break
+    os.kill(proc.pid, signal.SIGKILL)
+    proc.stdout.close()
+    proc.wait()
+    assert mined >= 5
+    height = json.loads(ck.with_suffix(".bin.json").read_text())["height"]
+    assert height >= mined - 2        # --checkpoint-every 2: <= 2 lost
+    import io
+    from contextlib import redirect_stdout
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = main(["mine", "--difficulty", "10", "--blocks",
+                   str(height + 2), "--backend", "cpu", "--resume",
+                   str(ck), "--out", str(tmp_path / "resumed.bin")])
+    assert rc == 0
+    summary = json.loads(buf.getvalue().splitlines()[-1])
+    assert summary["height"] == height + 2
+    node = core.Node(10, 0)
+    assert node.load((tmp_path / "resumed.bin").read_bytes())
+    assert node.height == height + 2
