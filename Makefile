@@ -14,11 +14,13 @@ check: lint metrics-smoke forensics-smoke perf-smoke chaos-smoke \
 
 # chainlint: binding contract, header layout, JAX purity, sanitizer
 # matrix, thread races (CONC), SPMD collectives, hot-path blocking,
-# device-sync provenance (SYNC), buffer donation (DON), and the two
-# committed ratchets: OPBUDGET.json (kernel ALU ops) and
-# TRANSFERBUDGET.json (sweep-path host<->device transfer sites) — so
-# `make check` gates on both budgets. --audit-suppressions rides the
-# same run and is warning-only: it prints rot but never fails the gate.
+# device-sync provenance (SYNC), buffer donation (DON), deadlint
+# (LCK lock-order, FUT future lifecycle, THR thread lifecycle), and
+# the three committed ratchets: OPBUDGET.json (kernel ALU ops),
+# TRANSFERBUDGET.json (sweep-path host<->device transfer sites), and
+# WAITBUDGET.json (sweep-scope blocking-wait sites) — so `make check`
+# gates on all three budgets. --audit-suppressions rides the same run
+# and is warning-only: it prints rot but never fails the gate.
 lint:
 	$(PY) -m mpi_blockchain_tpu.analysis --jobs 4 --audit-suppressions
 

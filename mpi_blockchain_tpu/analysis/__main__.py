@@ -18,6 +18,9 @@ Modes beyond the default lint run:
   census into TRANSFERBUDGET.json; a justified RAISE of either budget
   goes through its sanctioned mover (``roofline.py --write-budget`` /
   ``python -m mpi_blockchain_tpu.analysis.transfer_budget --write``).
+* ``--rebaseline-waits`` — the same ratchet for the blocking-wait
+  census into WAITBUDGET.json (mover: ``python -m
+  mpi_blockchain_tpu.analysis.thread_lint --write``).
 * ``--jobs N`` — run pass families on a thread pool; per-pass wall
   times are always collected and emitted under ``pass_timings_ms`` in
   ``--json`` output (which is a JSON object: ``{"findings": [...],
@@ -43,7 +46,9 @@ OVERRIDE_KEYS = ("capi", "ctypes_binding", "pybind", "chain_hpp",
                  "conc_files", "spmd_files", "elastic_files",
                  "hotpath_files", "opbudget_json", "kernel_src",
                  "sync_files", "donation_files",
-                 "transferbudget_json", "transfer_files")
+                 "transferbudget_json", "transfer_files",
+                 "lock_files", "future_files", "thread_files",
+                 "wait_files", "waitbudget_json")
 
 
 def _changed_files(root: pathlib.Path, rev: str) -> list[str] | None:
@@ -76,8 +81,9 @@ def main(argv: list[str] | None = None) -> int:
                     "(binding contract, header layout, JAX purity, "
                     "sanitizer matrix, thread races, SPMD collectives, "
                     "hot-path blocking, device-sync provenance, "
-                    "buffer donation, op-budget + transfer-budget "
-                    "ratchets)")
+                    "buffer donation, deadlint lock-order/future/"
+                    "thread lifecycle, op-budget + transfer-budget + "
+                    "wait-budget ratchets)")
     parser.add_argument("--root", type=pathlib.Path, default=None,
                         help="repo root (default: auto-detected)")
     parser.add_argument("--passes", default=None,
@@ -108,6 +114,10 @@ def main(argv: list[str] | None = None) -> int:
                         help="write the current static transfer-site "
                              "census into TRANSFERBUDGET.json (refuses "
                              "to raise it)")
+    parser.add_argument("--rebaseline-waits", action="store_true",
+                        help="write the current static blocking-wait "
+                             "census into WAITBUDGET.json (refuses to "
+                             "raise it)")
     parser.add_argument("-q", "--quiet", action="store_true",
                         help="suppress the summary/notes lines")
     args = parser.parse_args(argv)
@@ -142,6 +152,18 @@ def main(argv: list[str] | None = None) -> int:
                   file=sys.stderr)
             return 2
         print(f"chainlint: transfer budget rebaselined {old} -> {new} "
+              f"({path})", file=sys.stderr)
+        return 0
+
+    if args.rebaseline_waits:
+        from .thread_lint import rebaseline_waits
+        try:
+            old, new, path = rebaseline_waits(root, overrides)
+        except (ValueError, OSError) as e:
+            print(f"chainlint: rebaseline-waits refused: {e}",
+                  file=sys.stderr)
+            return 2
+        print(f"chainlint: wait budget rebaselined {old} -> {new} "
               f"({path})", file=sys.stderr)
         return 0
 

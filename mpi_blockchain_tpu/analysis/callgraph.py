@@ -193,6 +193,25 @@ class CallGraph:
                 missing.append((cls, method))
         return roots, missing
 
+    def owner_map(self, module: str) -> dict[int, "FuncInfo"]:
+        """id(ast node) -> FuncInfo of the innermost enclosing function,
+        for every node in ``module``'s functions. Traversal stops at
+        nested defs — each claims its own body. The one copy of the
+        innermost-owner lookup the flow-aware passes (CONC thread
+        spawns, LCK lock scopes, FUT future provenance) share."""
+        owners: dict[int, FuncInfo] = {}
+        for info in self.functions.values():
+            if info.module != module:
+                continue
+            stack = list(ast.iter_child_nodes(info.node))
+            while stack:
+                sub = stack.pop()
+                owners[id(sub)] = info
+                if isinstance(sub, _FUNC_NODES):
+                    continue
+                stack.extend(ast.iter_child_nodes(sub))
+        return owners
+
     def nested_parents(self) -> dict[str, str]:
         """{nested function qual: qual of its NEAREST enclosing analyzed
         function} for every closure/thread-body def. Passes that analyze

@@ -51,7 +51,7 @@ import ast
 import pathlib
 import re
 
-from . import Finding, override_files, rel_path
+from . import Finding, override_files, rel_path, source_cached
 from .callgraph import CallGraph, call_name, dotted
 
 #: Method names whose call mutates the receiver in place.
@@ -71,13 +71,16 @@ def _is_lockish(expr: ast.expr) -> bool:
     Matched per name TOKEN (split on ``.``/``_``), not by raw substring:
     ``self._lock``, ``_active_lock``, ``rlock``, ``mutex``, ``cond`` /
     ``condition`` all match, while ``deadline_seconds`` must not (its
-    'cond' is an accident of 'seconds')."""
+    'cond' is an accident of 'seconds') and ``trace_block`` /
+    ``_begin_block`` must not either (their 'block' ends in 'lock' by
+    the same accident)."""
     text = dotted(expr)
     if not text and isinstance(expr, ast.Call):
         text = dotted(expr.func)
     tokens = re.split(r"[._]+", text.lower())
     return any(tok.startswith(("lock", "mutex", "cond"))
-               or tok.endswith(("lock", "mutex"))
+               or (tok.endswith(("lock", "mutex"))
+                   and not tok.endswith("block"))
                for tok in tokens if tok)
 
 
@@ -122,23 +125,6 @@ def _thread_targets(tree: ast.Module, graph: CallGraph,
         for expr in exprs:
             targets.extend(graph.resolve_ref(expr, caller))
     return targets
-
-
-def _owner_map(graph: CallGraph, module: str) -> dict[int, "object"]:
-    """id(ast node) -> FuncInfo of the innermost enclosing function.
-    Traversal stops at nested defs — each claims its own body."""
-    owners: dict[int, object] = {}
-    for info in graph.functions.values():
-        if info.module != module:
-            continue
-        stack = list(ast.iter_child_nodes(info.node))
-        while stack:
-            sub = stack.pop()
-            owners[id(sub)] = info
-            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                continue
-            stack.extend(ast.iter_child_nodes(sub))
-    return owners
 
 
 class _MutationCollector(ast.NodeVisitor):
@@ -249,20 +235,18 @@ _SPAWN_TOKENS = ("Thread(", "Timer(", ".submit(", ".map(")
 def _scan_module(root: pathlib.Path, path: pathlib.Path) -> list[Finding]:
     rel = rel_path(path, root)
     try:
-        text = path.read_text()
+        text, tree, err = source_cached(path)
     except OSError:
         return []
     if not any(tok in text for tok in _SPAWN_TOKENS):
         return []
-    try:
-        tree = ast.parse(text, filename=str(path))
-    except SyntaxError as e:
-        return [Finding(rel, e.lineno or 1, "CONC000",
-                        f"syntax error: {e.msg}")]
+    if tree is None:
+        return [Finding(rel, err[0], "CONC000",
+                        f"syntax error: {err[1]}")]
 
     graph = CallGraph()
     graph.add_module(rel, tree)
-    owners = _owner_map(graph, rel)
+    owners = graph.owner_map(rel)
     targets = _thread_targets(tree, graph, owners)
     if not targets:
         return []
@@ -314,6 +298,8 @@ def _scan_module(root: pathlib.Path, path: pathlib.Path) -> list[Finding]:
 
 
 def _scoped_files(root: pathlib.Path) -> list[pathlib.Path]:
+    """The threaded-substrate scope (package + experiments/) — the ONE
+    copy shared by the conc, lock, future, and thread families."""
     pkg = root / "mpi_blockchain_tpu"
     files = [p for p in pkg.rglob("*.py") if "__pycache__" not in p.parts]
     exp = root / "experiments"

@@ -77,7 +77,9 @@ class ShardWriter:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         # Guards _seq: payload() runs from both the flusher thread and
-        # the closing caller (chainlint CONC001 holds this discipline).
+        # the closing caller (chainlint CONC001 + THR002 hold this
+        # discipline; the flusher interval wait and the bounded close
+        # join are committed WAITBUDGET.json sites).
         self._lock = threading.Lock()
 
     @property

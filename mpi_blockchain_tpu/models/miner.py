@@ -38,6 +38,7 @@ oracle.
 from __future__ import annotations
 
 import collections
+import concurrent.futures
 import dataclasses
 import functools
 import time
@@ -53,6 +54,16 @@ from ..telemetry import counter, heartbeat, histogram
 from ..telemetry.events import emit_event, env_number
 from ..telemetry.spans import span
 from ..utils.logging import block_logger
+
+#: Watchdog budget (seconds) for ONE in-flight dispatch at the
+#: pipelined consume point. A healthy sweep completes in
+#: milliseconds-to-seconds; a wedged device dispatch used to park
+#: ``_consume`` in an unbounded ``Future.result()`` forever — the hang
+#: class chainlint FUT002 flags and ``guarded_collective`` kills for
+#: collectives. 900 s is "the dispatch is gone" (the bench harness's
+#: device-init budget), not "the sweep is slow".
+DISPATCH_TIMEOUT_S = env_number("MPIBT_DISPATCH_TIMEOUT", 900.0,
+                                cast=float, minimum=1e-3)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -137,7 +148,9 @@ def _drain_discarded(d: _SweepDispatch, fut) -> None:
     if fut.cancelled():
         return
     try:
-        fut.result()
+        # Runs inside the future's own done-callback: the future is
+        # already resolved, so this result() returns without blocking.
+        fut.result()  # chainlint: disable=FUT002
     except BaseException as e:
         # A discarded dispatch that also FAILED: nothing to account,
         # but the failure is an event a post-mortem can see.
@@ -400,11 +413,26 @@ class Miner:
 
     def _consume(self, d: _SweepDispatch):
         """Blocks on one dispatch's result (strictly in issue order —
-        the lowest-nonce rule) and records its device window with the
-        dispatch's own block identity."""
+        the lowest-nonce rule), bounded by ``MPIBT_DISPATCH_TIMEOUT``
+        so a wedged backend surfaces as a loud failure instead of a
+        silent hang, and records its device window with the dispatch's
+        own block identity."""
         with span("miner.sweep", height=d.height,
                   extra_nonce=d.template):
-            res = d.future.result()
+            try:
+                res = d.future.result(timeout=DISPATCH_TIMEOUT_S)
+            except concurrent.futures.TimeoutError:
+                if d.future.done():
+                    # The SWEEP raised a TimeoutError (the classes alias
+                    # on 3.12+): a real backend failure, not a wedged
+                    # wait — let it surface with its own traceback.
+                    raise
+                raise RuntimeError(
+                    f"dispatch wedged: sweep for height {d.height} "
+                    f"(template {d.template}, window "
+                    f"{d.window_index}) returned nothing within "
+                    f"{DISPATCH_TIMEOUT_S}s (MPIBT_DISPATCH_TIMEOUT) — "
+                    f"treating the backend as hung") from None
         t0, t1 = d.device_window()
         with trace_block(d.height, template=d.template):
             d.prec.add_segment("device", t0, t1)

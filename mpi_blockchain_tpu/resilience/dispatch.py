@@ -98,6 +98,10 @@ class ResilientBackend(MinerBackend):
         # at a time — a speculative dispatch that exhausts its rung
         # degrades the ladder exactly once, and the next dispatch starts
         # on the surviving rung instead of racing a half-rebuilt one.
+        # chainlint deadlint holds this shape: THR002 accepts
+        # _step_down's unlocked writes because its every call site is
+        # lock-held (the one-hop rule), and LCK treats the RLock's
+        # re-acquisition as reentrancy, not an inversion.
         self._lock = threading.RLock()
         self._worker: concurrent.futures.ThreadPoolExecutor | None = None
 

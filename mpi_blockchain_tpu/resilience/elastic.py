@@ -104,6 +104,9 @@ class _GuardWorker:
 
     def _loop(self) -> None:
         while True:
+            # The idle park between dispatches: a sanctioned FUT002
+            # waiter seam (the watchdog guards the DISPATCH, not this
+            # daemon worker waiting for work).
             fn, out = self.inbox.get()
             try:
                 out.put(("ok", fn()))

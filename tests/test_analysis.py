@@ -1632,12 +1632,31 @@ def _trb_case(tmp_path):
             "TRB001", src, "# ")
 
 
+def _lck_case(tmp_path):
+    path = tmp_path / "bad_locks.py"
+    path.write_text(BAD_LCK)
+    return {"lock_files": [path]}, "LCK001", path, "# "
+
+
+def _fut_case(tmp_path):
+    path = tmp_path / "bad_futures.py"
+    path.write_text(BAD_FUT)
+    return {"future_files": [path]}, "FUT001", path, "# "
+
+
+def _thr_case(tmp_path):
+    path = tmp_path / "bad_thread_mod.py"
+    path.write_text(BAD_THR)
+    return {"thread_files": [path]}, "THR001", path, "# "
+
+
 MATRIX_CASES = {
     "binding": _capi_case, "header": _chain_hpp_case, "jax": _jax_case,
     "sanitizers": _san_case, "telemetry": _tel_case,
     "resilience": _res_case, "conc": _conc_case, "spmd": _spmd_case,
     "hotpath": _hot_case, "opbudget": _opb_case, "sync": _sync_case,
-    "don": _don_case, "trb": _trb_case,
+    "don": _don_case, "trb": _trb_case, "lock": _lck_case,
+    "future": _fut_case, "thread": _thr_case,
 }
 
 
@@ -2753,3 +2772,854 @@ def test_sync_compiled_regex_search_is_not_device_origin(tmp_path):
                 return n
         """))
     assert findings == [], "\n".join(f.render() for f in findings)
+
+
+# ---- v4 deadlint: LCK lock-order / hold-while-waiting ------------------
+
+
+BAD_LCK = textwrap.dedent("""\
+    import threading
+
+    _a_lock = threading.Lock()
+    _b_lock = threading.Lock()
+
+
+    def forward(q):
+        with _a_lock:
+            with _b_lock:                  # A -> B witness
+                q.put(1)
+
+
+    def backward(fut):
+        with _b_lock:
+            with _a_lock:                  # B -> A: LCK001
+                pass
+            res = fut.result()             # LCK002: wait under _b_lock
+            return res
+
+
+    def notify(cb):
+        with _a_lock:
+            on_block = cb
+            on_block()                     # LCK003: callback under lock
+    """)
+
+OK_LCK = textwrap.dedent("""\
+    import threading
+
+    _a_lock = threading.Lock()
+    _b_lock = threading.Lock()
+
+
+    def one(q):
+        with _a_lock:
+            with _b_lock:
+                q.put(1)
+
+
+    def two(q):
+        with _a_lock:
+            with _b_lock:
+                return q.get(timeout=1.0)
+
+
+    def three(fut):
+        res = fut.result(timeout=5.0)
+        with _a_lock:
+            return res
+    """)
+
+
+def _lck(tmp_path, text, name="mod.py"):
+    from mpi_blockchain_tpu.analysis.lock_lint import run_lock_lint
+
+    path = tmp_path / name
+    path.write_text(text)
+    return run_lock_lint(ROOT, overrides={"lock_files": [path]})
+
+
+def test_lck_rules_fire(tmp_path):
+    findings = _lck(tmp_path, BAD_LCK)
+    assert sorted(f.rule for f in findings) == \
+        ["LCK001", "LCK002", "LCK003"], \
+        "\n".join(f.render() for f in findings)
+    by_rule = {f.rule: f for f in findings}
+    assert by_rule["LCK001"].line == 9      # first witness anchors
+    assert "_a_lock" in by_rule["LCK001"].message
+    assert "_b_lock" in by_rule["LCK001"].message
+    assert "line 15" in by_rule["LCK001"].message
+    assert by_rule["LCK002"].line == 17
+    assert ".result()" in by_rule["LCK002"].message
+    assert by_rule["LCK003"].line == 24
+    assert "on_block" in by_rule["LCK003"].message
+
+
+def test_lck_consistent_order_and_bounded_waits_clean(tmp_path):
+    assert _lck(tmp_path, OK_LCK) == []
+
+
+def test_lck001_inversion_that_conc_misses(tmp_path):
+    """The acceptance fixture: both orders lock CONSISTENTLY around the
+    shared state, so CONC (which needs an UNLOCKED mutation site) sees
+    nothing — only the acquisition-order graph catches the deadlock."""
+    from mpi_blockchain_tpu.analysis.conc_lint import run_conc_lint
+    from mpi_blockchain_tpu.analysis.lock_lint import run_lock_lint
+
+    text = textwrap.dedent("""\
+        import threading
+
+        _stats = {}
+        _stats_lock = threading.Lock()
+        _ring = []
+        _ring_lock = threading.Lock()
+
+
+        def _flusher():
+            with _stats_lock:
+                with _ring_lock:
+                    _ring.append(dict(_stats))
+
+
+        def record(x):
+            with _ring_lock:
+                with _stats_lock:
+                    _stats["n"] = x
+
+
+        def start():
+            threading.Thread(target=_flusher, daemon=True).start()
+            record(1)
+        """)
+    path = tmp_path / "mod.py"
+    path.write_text(text)
+    assert run_conc_lint(ROOT, overrides={"conc_files": [path]}) == []
+    findings = run_lock_lint(ROOT, overrides={"lock_files": [path]})
+    assert [f.rule for f in findings] == ["LCK001"], \
+        "\n".join(f.render() for f in findings)
+    assert "_stats_lock" in findings[0].message
+    assert "_ring_lock" in findings[0].message
+
+
+def test_lck002_transitive_wait_via_module_local_call(tmp_path):
+    """A blocking wait one call hop below the lock scope is flagged at
+    the CALL site (the line that holds the lock), with the chain."""
+    findings = _lck(tmp_path, textwrap.dedent("""\
+        import threading
+
+        _lock = threading.Lock()
+
+
+        def _drain(q):
+            return q.get()
+
+
+        def close(q):
+            with _lock:
+                _drain(q)
+        """))
+    assert [f.rule for f in findings] == ["LCK002"], findings
+    assert findings[0].line == 12
+    assert ".get()" in findings[0].message
+    assert "_drain" in findings[0].message
+
+
+def test_lck_self_reacquire_not_an_inversion(tmp_path):
+    """The single-flight RLock idiom: a lock-held method calling back
+    into a method that takes the SAME lock is reentrancy, not an
+    inversion (same-key edges are skipped)."""
+    findings = _lck(tmp_path, textwrap.dedent("""\
+        import threading
+
+
+        class Backend:
+            def __init__(self):
+                self._lock = threading.RLock()
+
+            def search(self, h):
+                with self._lock:
+                    return self._retry(h)
+
+            def _retry(self, h):
+                with self._lock:
+                    return h
+        """))
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_lck_inline_suppression(tmp_path):
+    suppressed = BAD_LCK.replace(
+        "        with _b_lock:                  # A -> B witness",
+        "        with _b_lock:  # chainlint: disable=LCK001")
+    path = tmp_path / "mod.py"
+    path.write_text(suppressed)
+    findings = run_all(root=tmp_path, passes=["lock"],
+                       overrides={"lock_files": [path]})
+    rules = {f.rule for f in findings}
+    assert "LCK001" not in rules
+    assert {"LCK002", "LCK003"} <= rules
+
+
+def test_lck_live_tree_clean():
+    """The live threaded substrate holds one global acquisition order
+    and never waits unbounded under a lock."""
+    from mpi_blockchain_tpu.analysis.lock_lint import run_lock_lint
+
+    findings = run_lock_lint(ROOT)
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_lck_cli_pass_family(tmp_path):
+    path = tmp_path / "mod.py"
+    path.write_text(BAD_LCK)
+    proc = subprocess.run(
+        [sys.executable, "-m", "mpi_blockchain_tpu.analysis",
+         "--passes", "lock", "--override", f"lock_files={path}"],
+        cwd=ROOT, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "LCK001" in proc.stdout and "LCK002" in proc.stdout
+
+
+# ---- v4 deadlint: FUT future lifecycle ---------------------------------
+
+
+BAD_FUT = textwrap.dedent("""\
+    import threading
+
+    _records = []
+
+
+    class Miner:
+        def mine(self, backend, pool):
+            fut = backend.search_async(b"x", 16)     # FUT001: dropped
+            pool.submit(self._sweep)                 # FUT001: discarded
+            got = backend.search_async(b"x", 20)
+            return got.result()                      # FUT002: unbounded
+
+        def _sweep(self):
+            pass
+
+
+    def arm(fut):
+        fut.add_done_callback(lambda f: _records.append(f))   # FUT003
+    """)
+
+
+def _fut(tmp_path, text, name="mod.py"):
+    from mpi_blockchain_tpu.analysis.future_lint import run_future_lint
+
+    path = tmp_path / name
+    path.write_text(text)
+    return run_future_lint(ROOT, overrides={"future_files": [path]})
+
+
+def test_fut_rules_fire(tmp_path):
+    findings = _fut(tmp_path, BAD_FUT)
+    assert sorted(f.rule for f in findings) == \
+        ["FUT001", "FUT001", "FUT002", "FUT003"], \
+        "\n".join(f.render() for f in findings)
+    by_line = {(f.rule, f.line) for f in findings}
+    assert ("FUT001", 8) in by_line      # fut never consumed
+    assert ("FUT001", 9) in by_line      # bare submit discarded
+    assert ("FUT002", 11) in by_line
+    assert ("FUT003", 18) in by_line
+    fut003 = next(f for f in findings if f.rule == "FUT003")
+    assert "_records" in fut003.message
+
+
+def test_fut002_sanctioned_waiter_seams(tmp_path):
+    """guarded_collective and the _GuardWorker inbox loop ARE the
+    sanctioned unbounded waits; the same shape elsewhere fires."""
+    findings = _fut(tmp_path, textwrap.dedent("""\
+        class _GuardWorker:
+            def _loop(self):
+                fn, out = self.inbox.get()
+                return fn, out
+
+
+        def guarded_collective(fn, out):
+            return out.get()
+
+
+        def unsanctioned(out):
+            return out.get()
+        """))
+    assert [(f.rule, f.line) for f in findings] == [("FUT002", 12)], \
+        "\n".join(f.render() for f in findings)
+
+
+def test_fut_single_flight_worker_shape_clean(tmp_path):
+    """The live ResilientBackend shape: RLock-guarded ladder, one
+    dispatch worker, the submitted future returned to the caller —
+    clean across the lock, future, AND thread families (the shape the
+    deadlint families must never regress on)."""
+    from mpi_blockchain_tpu.analysis.future_lint import run_future_lint
+    from mpi_blockchain_tpu.analysis.lock_lint import run_lock_lint
+    from mpi_blockchain_tpu.analysis.thread_lint import run_thread_lint
+
+    text = textwrap.dedent("""\
+        import concurrent.futures
+        import threading
+
+
+        class ResilientBackend:
+            def __init__(self):
+                self._lock = threading.RLock()
+                self._worker = None
+                self._i = 0
+
+            def search(self, header):
+                with self._lock:
+                    while True:
+                        try:
+                            return self._checked(header)
+                        except RuntimeError:
+                            if not self._step_down():
+                                raise
+
+            def search_async(self, header):
+                with self._lock:
+                    if self._worker is None:
+                        self._worker = \\
+                            concurrent.futures.ThreadPoolExecutor(1)
+                    worker = self._worker
+                return worker.submit(self.search, header)
+
+            def _checked(self, header):
+                return header
+
+            def _step_down(self):
+                self._i += 1
+                return self._i < 3
+        """)
+    path = tmp_path / "mod.py"
+    path.write_text(text)
+    assert run_lock_lint(ROOT, overrides={"lock_files": [path]}) == []
+    assert run_future_lint(ROOT, overrides={"future_files": [path]}) == []
+    thr = [f for f in run_thread_lint(ROOT,
+                                      overrides={"thread_files": [path]})
+           if f.rule.startswith("THR")]
+    assert thr == [], "\n".join(f.render() for f in thr)
+
+
+def test_fut_done_callback_drain_shape_clean(tmp_path):
+    """The live discard-drain shape: cancel, else drain through a
+    done-callback that touches only the dispatch-local object (the
+    justified result() suppression rides along, like the live file)."""
+    text = textwrap.dedent("""\
+        import functools
+
+
+        def _drain_discarded(d, fut):
+            if fut.cancelled():
+                return
+            try:
+                # done-callback: the future is already resolved
+                fut.result()  # chainlint: disable=FUT002
+            except BaseException:
+                return
+            d.strip()
+
+
+        def discard_speculative(pending):
+            while pending:
+                d = pending.popleft()
+                if not d.future.cancel():
+                    d.future.add_done_callback(
+                        functools.partial(_drain_discarded, d))
+        """)
+    path = tmp_path / "mod.py"
+    path.write_text(text)
+    findings = run_all(root=tmp_path, passes=["future"],
+                       overrides={"future_files": [path]})
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_fut003_named_callback_with_lock_clean(tmp_path):
+    """A done-callback that takes the owning lock before mutating is
+    the sanctioned shape."""
+    findings = _fut(tmp_path, textwrap.dedent("""\
+        import threading
+
+        _records = []
+        _records_lock = threading.Lock()
+
+
+        def _on_done(fut):
+            with _records_lock:
+                _records.append(fut)
+
+
+        def arm(fut):
+            fut.add_done_callback(_on_done)
+        """))
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_fut_inline_suppression(tmp_path):
+    suppressed = BAD_FUT.replace(
+        "        return got.result()                      # FUT002: unbounded",
+        "        return got.result()  # chainlint: disable=FUT002")
+    path = tmp_path / "mod.py"
+    path.write_text(suppressed)
+    findings = run_all(root=tmp_path, passes=["future"],
+                       overrides={"future_files": [path]})
+    rules = {f.rule for f in findings}
+    assert "FUT002" not in rules
+    assert {"FUT001", "FUT003"} <= rules
+
+
+def test_fut_live_tree_justified_suppressions_only():
+    """run_all is clean; the raw findings are exactly the two justified
+    FUT002 suppressions (the done-callback drain and the lint engine's
+    own finite pool), which still fire raw — the audit's non-stale
+    contract. The third live .result() is the FIXED one: bounded by
+    MPIBT_DISPATCH_TIMEOUT, so it is not a finding at all."""
+    from mpi_blockchain_tpu.analysis.future_lint import run_future_lint
+
+    assert run_all(root=ROOT, passes=["future"]) == []
+    raw = run_future_lint(ROOT)
+    assert {f.rule for f in raw} == {"FUT002"}, \
+        "\n".join(f.render() for f in raw)
+    assert sorted(f.file for f in raw) == [
+        "mpi_blockchain_tpu/analysis/__init__.py",
+        "mpi_blockchain_tpu/models/miner.py"]
+
+
+def test_miner_consume_bounded_fix_pinned():
+    """The live pipelined consume is the FIXED FUT002: an explicit
+    timeout from MPIBT_DISPATCH_TIMEOUT, raising a loud dispatch-wedged
+    error instead of hanging forever."""
+    miner = (ROOT / "mpi_blockchain_tpu" / "models" /
+             "miner.py").read_text()
+    assert "result(timeout=DISPATCH_TIMEOUT_S)" in miner
+    assert "MPIBT_DISPATCH_TIMEOUT" in miner
+    assert "dispatch wedged" in miner
+
+
+def test_fut_cli_pass_family(tmp_path):
+    path = tmp_path / "mod.py"
+    path.write_text(BAD_FUT)
+    proc = subprocess.run(
+        [sys.executable, "-m", "mpi_blockchain_tpu.analysis",
+         "--passes", "future", "--override", f"future_files={path}"],
+        cwd=ROOT, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "FUT001" in proc.stdout and "FUT003" in proc.stdout
+
+
+# ---- v4 deadlint: THR thread lifecycle ---------------------------------
+
+
+BAD_THR = textwrap.dedent("""\
+    import threading
+
+
+    class Runner:
+        def __init__(self):
+            self.done = False
+
+        def start(self):
+            t = threading.Thread(target=self._loop)    # THR001
+            t.start()
+            threading.Thread(target=self._loop).start()   # THR001
+            return t
+
+        def _loop(self):
+            self.done = True                           # THR002
+
+        def is_done(self):
+            return self.done
+    """)
+
+OK_THR = textwrap.dedent("""\
+    import threading
+
+
+    class Runner:
+        def start(self):
+            self._t = threading.Thread(target=self._loop, daemon=True)
+            self._t.start()
+        w = threading.Timer(5.0, _fire)
+        w.daemon = True
+        w.start()
+
+        def spawn_and_reap(self):
+            v = threading.Thread(target=self._loop)
+            v.start()
+            v.join(timeout=5)
+
+        def _loop(self):
+            pass
+
+
+    def _fire():
+        pass
+    """)
+
+
+def _thr(tmp_path, text, name="mod.py"):
+    from mpi_blockchain_tpu.analysis.thread_lint import run_thread_lint
+
+    path = tmp_path / name
+    path.write_text(text)
+    return [f for f in run_thread_lint(
+        ROOT, overrides={"thread_files": [path]})
+        if f.rule.startswith("THR")]
+
+
+def test_thr_rules_fire(tmp_path):
+    findings = _thr(tmp_path, BAD_THR)
+    assert sorted(f.rule for f in findings) == \
+        ["THR001", "THR001", "THR002"], \
+        "\n".join(f.render() for f in findings)
+    by_line = {(f.rule, f.line) for f in findings}
+    assert ("THR001", 9) in by_line
+    assert ("THR001", 11) in by_line
+    assert ("THR002", 15) in by_line
+    thr2 = next(f for f in findings if f.rule == "THR002")
+    assert "Runner.done" in thr2.message
+
+
+def test_thr001_daemon_and_reaped_shapes_clean(tmp_path):
+    """daemon=True at the ctor, t.daemon = True post-set (the bench
+    watchdog shape), and join/cancel on every handle are all clean."""
+    assert _thr(tmp_path, OK_THR) == []
+
+
+def test_thr002_host_side_mutation_is_conc_jurisdiction(tmp_path):
+    """When the host also MUTATES the state, the pair belongs to
+    CONC001 — THR002 must not double-fire."""
+    from mpi_blockchain_tpu.analysis.conc_lint import run_conc_lint
+
+    text = BAD_THR.replace("        return self.done",
+                           "        self.done = False\n"
+                           "        return self.done")
+    path = tmp_path / "mod.py"
+    path.write_text(text)
+    from mpi_blockchain_tpu.analysis.thread_lint import run_thread_lint
+    thr = [f for f in run_thread_lint(ROOT,
+                                      overrides={"thread_files": [path]})
+           if f.rule == "THR002"]
+    assert thr == [], "\n".join(f.render() for f in thr)
+    conc = run_conc_lint(ROOT, overrides={"conc_files": [path]})
+    assert "CONC001" in {f.rule for f in conc}
+
+
+def test_thr002_lock_held_call_sites_excused(tmp_path):
+    """The single-flight idiom: a helper whose EVERY call site is
+    inside a with-lock extent writes lock-held even though it does not
+    spell the with itself (the live _step_down shape)."""
+    findings = _thr(tmp_path, textwrap.dedent("""\
+        import threading
+
+
+        class Backend:
+            def __init__(self):
+                self._lock = threading.RLock()
+                self._i = 0
+
+            def run(self, pool):
+                pool.submit(self.search)
+
+            def search(self):
+                with self._lock:
+                    self._step_down()
+
+            def _step_down(self):
+                self._i += 1
+
+            def rung(self):
+                return self._i
+        """))
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_thr_inline_suppression(tmp_path):
+    suppressed = BAD_THR.replace(
+        "        t = threading.Thread(target=self._loop)    # THR001",
+        "        t = threading.Thread(target=self._loop)  "
+        "# chainlint: disable=THR001")
+    path = tmp_path / "mod.py"
+    path.write_text(suppressed)
+    findings = [f for f in run_all(root=tmp_path, passes=["thread"],
+                                   overrides={"thread_files": [path]})
+                if f.rule == "THR001"]
+    assert len(findings) == 1
+
+
+def test_thr_live_tree_clean():
+    """Every live thread is daemonic or reaped, and every thread-side
+    write is lock-guarded or lock-held by its call sites."""
+    from mpi_blockchain_tpu.analysis.thread_lint import run_thread_lint
+
+    findings = run_thread_lint(ROOT)
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_thr_cli_pass_family(tmp_path):
+    path = tmp_path / "mod.py"
+    path.write_text(BAD_THR)
+    proc = subprocess.run(
+        [sys.executable, "-m", "mpi_blockchain_tpu.analysis",
+         "--passes", "thread", "--override", f"thread_files={path}"],
+        cwd=ROOT, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "THR001" in proc.stdout and "THR002" in proc.stdout
+
+
+# ---- TBW: the blocking-wait budget ratchet -----------------------------
+
+
+def _wait_budget_json(tmp_path, **over):
+    data = {"static_wait_sites": 999, "sites": [], **over}
+    path = tmp_path / "WAITBUDGET.json"
+    path.write_text(json.dumps(data))
+    return path
+
+
+def _wait_src(tmp_path):
+    src = tmp_path / "waits.py"
+    src.write_text("import threading\n"
+                   "_lock = threading.Lock()\n\n\n"
+                   "def f(q):\n"
+                   "    with _lock:\n"
+                   "        q.put(1)\n"
+                   "    return q.get()\n")
+    return src
+
+
+def test_tbw_live_tree_gate_is_armed_and_green():
+    from mpi_blockchain_tpu.analysis.thread_lint import run_thread_lint
+
+    assert (ROOT / "WAITBUDGET.json").is_file(), \
+        "the committed WAITBUDGET.json is the blocking-wait ratchet gate"
+    assert run_thread_lint(ROOT) == []
+    data = json.loads((ROOT / "WAITBUDGET.json").read_text())
+    # Every committed wait site names the seam that sanctions it.
+    assert data["static_wait_sites"] == len(data["sites"]) > 0
+    assert all(site["seam"] for site in data["sites"])
+    assert not any("unsanctioned" in site["seam"]
+                   for site in data["sites"]), \
+        "an unsanctioned wait site is committed without a seam owner"
+    miner_sites = [s for s in data["sites"]
+                   if s["file"].endswith("models/miner.py")]
+    assert any(s["label"] == ".result()" for s in miner_sites)
+
+
+def test_tbw_grown_census_fires_tbw001(tmp_path):
+    from mpi_blockchain_tpu.analysis.thread_lint import run_thread_lint
+
+    budget = _wait_budget_json(tmp_path, static_wait_sites=1)
+    src = _wait_src(tmp_path)
+    findings = run_thread_lint(
+        ROOT, overrides={"waitbudget_json": budget,
+                         "wait_files": [src], "thread_files": []})
+    assert [f.rule for f in findings] == ["TBW001"], \
+        "\n".join(f.render() for f in findings)
+    assert findings[0].file == str(src) and findings[0].line == 6
+    assert "2 > budget 1" in findings[0].message
+
+
+def test_tbw_missing_or_malformed_baseline_fires_tbw002(tmp_path):
+    from mpi_blockchain_tpu.analysis.thread_lint import run_thread_lint
+
+    for budget in (tmp_path / "absent.json",
+                   _wait_budget_json(tmp_path, static_wait_sites=-2)):
+        findings = run_thread_lint(
+            ROOT, overrides={"waitbudget_json": budget,
+                             "thread_files": []})
+        assert [f.rule for f in findings] == ["TBW002"], findings
+    nosites = tmp_path / "nosites.json"
+    nosites.write_text(json.dumps({"static_wait_sites": 5}))
+    findings = run_thread_lint(
+        ROOT, overrides={"waitbudget_json": nosites, "thread_files": []})
+    assert [f.rule for f in findings] == ["TBW002"], findings
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    findings = run_thread_lint(
+        ROOT, overrides={"waitbudget_json": bad, "thread_files": []})
+    assert [f.rule for f in findings] == ["TBW002"], findings
+
+
+def test_tbw_empty_scope_fires_tbw003(tmp_path):
+    from mpi_blockchain_tpu.analysis.thread_lint import run_thread_lint
+
+    budget = _wait_budget_json(tmp_path)
+    findings = run_thread_lint(
+        ROOT, overrides={"waitbudget_json": budget,
+                         "wait_files": [tmp_path / "gone.py"],
+                         "thread_files": []})
+    assert [f.rule for f in findings] == ["TBW003"], findings
+
+
+def test_tbw_rebaseline_refuses_upward(tmp_path):
+    from mpi_blockchain_tpu.analysis.thread_lint import rebaseline_waits
+
+    budget = _wait_budget_json(tmp_path, static_wait_sites=0)
+    src = _wait_src(tmp_path)
+    with pytest.raises(ValueError, match="refusing to rebaseline"):
+        rebaseline_waits(ROOT, {"waitbudget_json": budget,
+                                "wait_files": [src]})
+    assert json.loads(budget.read_text())["static_wait_sites"] == 0
+
+
+def test_tbw_rebaseline_ratchets_down(tmp_path):
+    from mpi_blockchain_tpu.analysis.thread_lint import (rebaseline_waits,
+                                                         run_thread_lint)
+
+    budget = _wait_budget_json(tmp_path, static_wait_sites=7,
+                               note="keep me")
+    src = _wait_src(tmp_path)
+    old, new, path = rebaseline_waits(
+        ROOT, {"waitbudget_json": budget, "wait_files": [src]})
+    assert (old, new) == (7, 2)
+    data = json.loads(path.read_text())
+    assert data["static_wait_sites"] == 2
+    assert data["by_label"] == {".get()": 1, "with-lock": 1}
+    assert data["note"] == "keep me"     # unrelated keys preserved
+    assert [s["label"] for s in data["sites"]] == ["with-lock", ".get()"]
+    assert all("unsanctioned" in s["seam"] for s in data["sites"])
+    assert run_thread_lint(
+        ROOT, overrides={"waitbudget_json": path, "wait_files": [src],
+                         "thread_files": []}) == []
+
+
+def test_tbw_rebaseline_requires_valid_baseline(tmp_path):
+    from mpi_blockchain_tpu.analysis.thread_lint import rebaseline_waits
+
+    src = _wait_src(tmp_path)
+    with pytest.raises(ValueError, match="no valid baseline"):
+        rebaseline_waits(ROOT,
+                         {"waitbudget_json": tmp_path / "absent.json",
+                          "wait_files": [src]})
+
+
+def test_tbw_cli_rebaseline_refusal_exits_2(tmp_path):
+    budget = _wait_budget_json(tmp_path, static_wait_sites=0)
+    src = _wait_src(tmp_path)
+    proc = subprocess.run(
+        [sys.executable, "-m", "mpi_blockchain_tpu.analysis",
+         "--rebaseline-waits",
+         "--override", f"waitbudget_json={budget}",
+         "--override", f"wait_files={src}"],
+        cwd=ROOT, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+    assert "refused" in proc.stderr
+
+
+def test_tbw_cli_pass_family(tmp_path):
+    budget = _wait_budget_json(tmp_path, static_wait_sites=0)
+    src = _wait_src(tmp_path)
+    proc = subprocess.run(
+        [sys.executable, "-m", "mpi_blockchain_tpu.analysis",
+         "--passes", "thread",
+         "--override", f"waitbudget_json={budget}",
+         "--override", f"wait_files={src}",
+         "--override", f"thread_files={tmp_path / 'none.py'}"],
+        cwd=ROOT, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "TBW001" in proc.stdout
+
+
+# ---- v4 families: engine integration -----------------------------------
+
+
+def test_audit_reports_stale_v4_suppressions(tmp_path):
+    from mpi_blockchain_tpu.analysis import audit_suppressions
+
+    root, pkg = _audit_root(tmp_path)
+    mod = pkg / "mod.py"
+    mod.write_text("a = 1  # chainlint: disable=LCK002\n"
+                   "b = 2  # chainlint: disable=FUT002\n"
+                   "c = 3  # chainlint: disable=THR001\n"
+                   "d = 4  # chainlint: disable=TBW001\n")
+    warnings = audit_suppressions(
+        root=root, passes=["lock", "future", "thread"],
+        overrides={"lock_files": [mod], "future_files": [mod],
+                   "thread_files": [mod], "wait_files": [mod]})
+    assert len(warnings) == 4, warnings
+    for rule in ("LCK002", "FUT002", "THR001", "TBW001"):
+        assert any(rule in w for w in warnings), (rule, warnings)
+
+
+def test_cli_json_timings_include_v4_passes(tmp_path):
+    proc = subprocess.run(
+        [sys.executable, "-m", "mpi_blockchain_tpu.analysis",
+         "--passes", "lock,future,thread", "--json", "-q"],
+        cwd=ROOT, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["findings"] == []
+    assert set(payload["pass_timings_ms"]) == {"lock", "future",
+                                               "thread"}
+    assert all(t >= 0 for t in payload["pass_timings_ms"].values())
+
+
+def test_families_for_changed_v4_scoping():
+    from mpi_blockchain_tpu.analysis import families_for_changed
+
+    got = families_for_changed(["WAITBUDGET.json"])
+    assert "thread" in got and "lock" not in got
+    got = families_for_changed(
+        ["mpi_blockchain_tpu/resilience/elastic.py"])
+    assert {"lock", "future", "thread", "conc"} <= set(got)
+
+
+def test_conc_lock_match_excludes_block_suffix(tmp_path):
+    """`with trace_block(...):` must NOT read as a lock ('block' ends
+    with 'lock' by substring accident): mutations inside it are
+    unsynchronized, and the wait census must not count it."""
+    findings = _conc(tmp_path, textwrap.dedent("""\
+        import threading
+
+        _ring = []
+
+
+        def trace_block(h):
+            return h
+
+
+        def flusher():
+            with trace_block(1):
+                _ring.append(1)
+
+
+        def start():
+            threading.Thread(target=flusher, daemon=True).start()
+            _ring.append(2)
+        """))
+    assert sorted(f.rule for f in findings) == ["CONC001", "CONC001"], \
+        "\n".join(f.render() for f in findings)
+
+
+def test_wait_census_excludes_trace_block_contexts(tmp_path):
+    from mpi_blockchain_tpu.analysis.thread_lint import static_wait_census
+
+    src = tmp_path / "mod.py"
+    src.write_text("def f(height, lock):\n"
+                   "    with trace_block(height):\n"
+                   "        pass\n"
+                   "    with lock:\n"
+                   "        pass\n")
+    total, by_label, sites, errors = static_wait_census(tmp_path, [src])
+    assert errors == []
+    assert total == 1 and by_label == {"with-lock": 1}
+    assert sites[0]["line"] == 4
+
+
+def test_source_cache_tracks_rewrites(tmp_path):
+    """The shared parse cache must re-parse a rewritten file (override
+    fixtures are rewritten in place by the matrix tests)."""
+    import ast as _ast
+
+    from mpi_blockchain_tpu.analysis import source_cached
+
+    p = tmp_path / "m.py"
+    p.write_text("x = 1\n")
+    _, t1, _ = source_cached(p)
+    p.write_text("y = 22\n")
+    _, t2, _ = source_cached(p)
+    assert _ast.dump(t1) != _ast.dump(t2)
+    p.write_text("z = (\n")
+    _, t3, err = source_cached(p)
+    assert t3 is None and err[0] >= 1

@@ -501,3 +501,23 @@ def test_sigkill_mid_overlap_resumes_with_bounded_loss(tmp_path):
     node = core.Node(10, 0)
     assert node.load((tmp_path / "resumed.bin").read_bytes())
     assert node.height == height + 2
+
+
+def test_pipelined_consume_bounded_by_dispatch_timeout(monkeypatch):
+    """A wedged dispatch (a future that never completes) surfaces as a
+    loud dispatch-wedged RuntimeError within MPIBT_DISPATCH_TIMEOUT
+    instead of parking _consume forever — the FUT002 hang class, killed
+    at the consume seam."""
+    from mpi_blockchain_tpu.models import miner as miner_mod
+
+    class WedgedBackend(CpuBackend):
+        def search_async(self, *a, **kw):
+            return concurrent.futures.Future()   # never completes
+
+    monkeypatch.setattr(miner_mod, "DISPATCH_TIMEOUT_S", 0.05)
+    cfg = MinerConfig(difficulty_bits=8, n_blocks=1, backend="cpu")
+    m = _quiet(cfg, backend=WedgedBackend(), pipeline=True)
+    t0 = time.perf_counter()
+    with pytest.raises(RuntimeError, match="dispatch wedged"):
+        m.mine_chain()
+    assert time.perf_counter() - t0 < 10.0
