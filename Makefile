@@ -4,13 +4,13 @@
 PY ?= python3
 SHELL := /bin/bash   # tier1 uses pipefail/PIPESTATUS
 
-.PHONY: check lint lint-fast metrics-smoke forensics-smoke perf-smoke \
-        chaos-smoke adversary-smoke meshwatch-smoke elastic-smoke \
-        trace-smoke pipeline-smoke tier1 core clean
+.PHONY: check lint lint-fast opbudget-check metrics-smoke forensics-smoke \
+        perf-smoke chaos-smoke adversary-smoke meshwatch-smoke \
+        elastic-smoke trace-smoke pipeline-smoke tier1 core clean
 
-check: lint metrics-smoke forensics-smoke perf-smoke chaos-smoke \
-        adversary-smoke meshwatch-smoke elastic-smoke trace-smoke \
-        pipeline-smoke tier1
+check: lint opbudget-check metrics-smoke forensics-smoke perf-smoke \
+        chaos-smoke adversary-smoke meshwatch-smoke elastic-smoke \
+        trace-smoke pipeline-smoke tier1
 
 # chainlint: binding contract, header layout, JAX purity, sanitizer
 # matrix, thread races (CONC), SPMD collectives, hot-path blocking,
@@ -28,6 +28,13 @@ lint:
 # changed since HEAD (git-diff driven; see docs/static_analysis.md).
 lint-fast:
 	$(PY) -m mpi_blockchain_tpu.analysis --since HEAD --jobs 4
+
+# OPBUDGET monotonicity guard: re-running the sanctioned mover on a
+# clean tree must reproduce the committed OPBUDGET.json byte-for-byte,
+# and a per-nonce census that moved UP fails loudly with the delta
+# (the ratchet only goes down; docs/perfwatch.md §Roofline).
+opbudget-check:
+	env JAX_PLATFORMS=cpu $(PY) experiments/roofline.py --check-budget
 
 # Telemetry smoke: the instrumented mini-run (mine + faulted sim) must
 # exit 0 and emit a Prometheus snapshot with the headline counters.

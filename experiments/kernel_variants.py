@@ -10,7 +10,8 @@ the production defaults in ops/sha256_pallas.py and bench.py:
   * Tile height sweep at 2^28: rows=64 → 967 MH/s (best), 128 → 840,
     256 → 565, 32 → 936, 8 → 575.
   * Round algebra (3-op ch, cached-term maj, no dead schedule expansion):
-    +4% at the plateau, adopted into _compress_unrolled.
+    +4% at the plateau, adopted into the unrolled round loops (now
+    _h1_tail_rounds/_h2_digest_h01 after the extended-midstate split).
   * A 32-round (wrong-hash) probe was NOT faster at small batches —
     proof the small-batch regime is dispatch-bound, not compute-bound.
   * Keeping uniform words scalar (SMEM values / numpy constants) instead

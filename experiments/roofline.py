@@ -4,8 +4,10 @@ Counts the VPU vector ops per nonce by tracing the production tile
 computation (ops/sha256_pallas.py:_tile_result) and counting jaxpr
 primitives whose output is the (ROWS, LANES) nonce tile — each such
 primitive is exactly one u32 ALU op per nonce. Scalar-core ops (uniform
-SMEM math) and trace-time numpy folds are excluded, mirroring what the
-VPU actually executes.
+SMEM math), the per-template host precompute
+(ops/sha256_sched.py:extend_midstate — counted separately as
+``host_ops_per_template``) and trace-time numpy folds are excluded,
+mirroring what the VPU actually executes per nonce.
 
 Peak rate derivation (public numbers only):
   * v5e peak bf16 matmul = 197 TFLOP/s with 4 MXUs of 128x128 MACs
@@ -15,12 +17,23 @@ Peak rate derivation (public numbers only):
 
 Usage: python experiments/roofline.py [measured_mhs]   (default 971.8)
        python experiments/roofline.py --write-budget [path]
+       python experiments/roofline.py --check-budget [path]
 
 ``--write-budget`` re-traces the census AND recomputes chainlint's
 static ALU census, then writes OPBUDGET.json (default: repo root) — the
 committed baseline the ``opbudget`` pass ratchets against
 (docs/static_analysis.md §OPBUDGET). This is the only sanctioned way to
 MOVE the budget; the stdlib-only gate can only hold or lower it.
+
+``--check-budget`` is the monotonicity guard `make check` runs: the
+mover re-run on a clean tree must reproduce the committed OPBUDGET.json
+byte-identically (rc 1 with a per-key delta otherwise, and a LOUD callout
+when a per-nonce census key moved UP — the ratchet only goes down).
+
+The traced census is also cross-checked against the stdlib closed form
+``perfwatch.attribution.kernel_op_model`` (they must agree exactly);
+the budget records the model's round/expansion algebra so the committed
+number stays explainable from first principles.
 """
 from __future__ import annotations
 
@@ -40,6 +53,7 @@ import pathlib  # noqa: E402
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
 from mpi_blockchain_tpu.ops import sha256_pallas as sp  # noqa: E402
+from mpi_blockchain_tpu.ops import sha256_sched as ss  # noqa: E402
 
 TILE_SHAPE = (sp._ROWS, sp._LANES)
 
@@ -58,15 +72,13 @@ _MOVE_PRIMS = {"iota", "broadcast_in_dim", "convert_element_type",
 
 def count_tile_ops(difficulty_bits: int = 24) -> dict:
     """Vector-op census of one production tile at the given difficulty."""
-    def tile(midstate, tail, base):
+    def tile(ext, base):
         # jnp arrays support the same [i] scalar reads the kernel does on
         # SMEM refs, so this traces the exact production code path.
-        return sp._tile_result(midstate, tail, base,
-                               difficulty_bits=difficulty_bits)
+        return sp._tile_result(ext, base, difficulty_bits=difficulty_bits)
 
     jaxpr = jax.make_jaxpr(tile)(
-        jnp.zeros((8,), jnp.uint32), jnp.zeros((16,), jnp.uint32),
-        jnp.uint32(0))
+        jnp.zeros((ss.EXT_WORDS,), jnp.uint32), jnp.uint32(0))
 
     alu = move = scalar = reduce_ = other = 0
     for eqn in jaxpr.jaxpr.eqns:
@@ -89,6 +101,17 @@ def count_tile_ops(difficulty_bits: int = 24) -> dict:
             "tile_nonces": sp.TILE, "difficulty_bits": difficulty_bits}
 
 
+def count_host_ops() -> int:
+    """Traced op count of the per-template host precompute
+    (extend_midstate) — ALU-prim eqns only, all scalar by construction.
+    Recorded separately from the per-nonce census so a hoist out of the
+    tile registers as a per-nonce DECREASE, not moved-ops noise."""
+    jaxpr = jax.make_jaxpr(ss.extend_midstate)(
+        jnp.zeros((8,), jnp.uint32), jnp.zeros((16,), jnp.uint32))
+    return sum(1 for eqn in jaxpr.jaxpr.eqns
+               if eqn.primitive.name in _ALU_PRIMS)
+
+
 def roofline(measured_mhs: float = 971.8) -> dict:
     # The peak/utilization closed form is formalized in
     # perfwatch.attribution (stdlib-only, shared with the regression
@@ -100,33 +123,111 @@ def roofline(measured_mhs: float = 971.8) -> dict:
             **utilization(measured_mhs * 1e6, census["alu_ops_per_nonce"])}
 
 
-def write_budget(path=None) -> dict:
-    """Writes the OPBUDGET.json baseline: the traced jaxpr census plus
-    the stdlib static census chainlint's opbudget pass recomputes."""
+def build_budget() -> dict:
+    """The full OPBUDGET.json dict: traced censuses (per-nonce tile +
+    per-template host), both stdlib static censuses chainlint's opbudget
+    pass recomputes, and the closed-form model components that make the
+    number explainable. Raises RuntimeError when a census entry is
+    missing (writing a disarmed budget would report success while
+    killing the gate)."""
     from mpi_blockchain_tpu.analysis.opbudget import (
-        CENSUS_ENTRY, KERNEL_SRC, static_alu_census)
+        CENSUS_ENTRY, HOST_ENTRY, HOST_SRC, KERNEL_SRC, static_alu_census)
+    from mpi_blockchain_tpu.perfwatch.attribution import kernel_op_model
 
     repo = pathlib.Path(__file__).resolve().parent.parent
-    path = pathlib.Path(path) if path is not None \
-        else repo / "OPBUDGET.json"
     static = static_alu_census(repo / KERNEL_SRC, CENSUS_ENTRY)
     if static is None:
-        # Writing "static_alu_ops": null would report success while
-        # disarming the gate (OPB002 on the next lint run, pointing
-        # back at this very command).
         raise RuntimeError(
             f"census entry {CENSUS_ENTRY!r} not found in {KERNEL_SRC} — "
             f"refusing to write an unarmed budget; update CENSUS_ENTRY "
             f"in mpi_blockchain_tpu/analysis/opbudget.py alongside the "
             f"rename, then rerun --write-budget")
-    budget = {
-        **count_tile_ops(),
+    static_host = static_alu_census(repo / HOST_SRC, HOST_ENTRY)
+    if static_host is None:
+        raise RuntimeError(
+            f"host census entry {HOST_ENTRY!r} not found in {HOST_SRC} — "
+            f"refusing to write an unarmed budget; update HOST_ENTRY in "
+            f"mpi_blockchain_tpu/analysis/opbudget.py alongside the "
+            f"rename, then rerun --write-budget")
+    census = count_tile_ops()
+    model = kernel_op_model(census["difficulty_bits"])
+    if model["total"] != census["alu_ops_per_nonce"]:
+        raise RuntimeError(
+            f"closed-form kernel model ({model['total']}) disagrees with "
+            f"the traced census ({census['alu_ops_per_nonce']}) — "
+            f"re-derive perfwatch.attribution.kernel_op_model alongside "
+            f"the kernel change so the committed number stays explainable")
+    return {
+        **census,
+        "host_ops_per_template": count_host_ops(),
         "static_alu_ops": static,
+        "static_host_alu_ops": static_host,
+        "model_components": model["components"],
         "source": KERNEL_SRC,
         "census_entry": CENSUS_ENTRY,
+        "host_source": HOST_SRC,
+        "host_census_entry": HOST_ENTRY,
     }
-    path.write_text(json.dumps(budget, indent=1, sort_keys=True) + "\n")
+
+
+def _render(budget: dict) -> str:
+    return json.dumps(budget, indent=1, sort_keys=True) + "\n"
+
+
+def write_budget(path=None) -> dict:
+    """Writes the OPBUDGET.json baseline (the one sanctioned mover)."""
+    repo = pathlib.Path(__file__).resolve().parent.parent
+    path = pathlib.Path(path) if path is not None \
+        else repo / "OPBUDGET.json"
+    budget = build_budget()
+    path.write_text(_render(budget))
     return budget
+
+
+#: Keys that may only ratchet DOWN between the committed budget and a
+#: clean re-trace (the monotonicity guard's loud-failure set).
+_RATCHET_KEYS = ("alu_ops_per_nonce", "static_alu_ops")
+
+
+def check_budget(path=None) -> int:
+    """`make check`'s opbudget-monotonicity guard: rebuilding the budget
+    on the current tree must reproduce the committed file byte-for-byte.
+    Returns 0 when identical; 1 with a per-key delta otherwise — and an
+    explicit ratchet-increase callout when a census key moved UP."""
+    repo = pathlib.Path(__file__).resolve().parent.parent
+    path = pathlib.Path(path) if path is not None \
+        else repo / "OPBUDGET.json"
+    try:
+        committed_text = path.read_text()
+        committed = json.loads(committed_text)
+    except (OSError, ValueError) as e:
+        print(f"opbudget-check: committed {path.name} unreadable ({e}); "
+              f"bootstrap it with --write-budget", file=sys.stderr)
+        return 1
+    fresh = build_budget()
+    if _render(fresh) == committed_text:
+        print(f"opbudget-check: ok ({fresh['alu_ops_per_nonce']} ALU "
+              f"ops/nonce, static {fresh['static_alu_ops']}, host "
+              f"{fresh['host_ops_per_template']}/template)")
+        return 0
+    keys = sorted(set(committed) | set(fresh))
+    for k in keys:
+        old, new = committed.get(k), fresh.get(k)
+        if old != new:
+            print(f"opbudget-check: {k}: committed {old!r} != "
+                  f"regenerated {new!r}", file=sys.stderr)
+    for k in _RATCHET_KEYS:
+        old, new = committed.get(k), fresh.get(k)
+        if isinstance(old, int) and isinstance(new, int) and new > old:
+            print(f"opbudget-check: RATCHET INCREASE: {k} {old} -> {new} "
+                  f"(+{new - old}) — the op count only ratchets down; a "
+                  f"justified increase must go through `python "
+                  f"experiments/roofline.py --write-budget` and a "
+                  f"reviewed OPBUDGET.json diff", file=sys.stderr)
+    print("opbudget-check: committed OPBUDGET.json does not reproduce — "
+          "re-run `python experiments/roofline.py --write-budget` and "
+          "commit the diff (it is the review surface)", file=sys.stderr)
+    return 1
 
 
 if __name__ == "__main__":
@@ -137,6 +238,13 @@ if __name__ == "__main__":
             print(f"roofline: {e}", file=sys.stderr)
             sys.exit(2)
         print(json.dumps(out, indent=1, sort_keys=True))
+    elif len(sys.argv) > 1 and sys.argv[1] == "--check-budget":
+        try:
+            sys.exit(check_budget(
+                sys.argv[2] if len(sys.argv) > 2 else None))
+        except RuntimeError as e:
+            print(f"roofline: {e}", file=sys.stderr)
+            sys.exit(2)
     else:
         mhs = float(sys.argv[1]) if len(sys.argv) > 1 else 971.8
         print(json.dumps(roofline(mhs), indent=1))

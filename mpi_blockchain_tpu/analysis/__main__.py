@@ -45,6 +45,7 @@ OVERRIDE_KEYS = ("capi", "ctypes_binding", "pybind", "chain_hpp",
                  "blocktrace_scope_files", "jax_files",
                  "conc_files", "spmd_files", "elastic_files",
                  "hotpath_files", "opbudget_json", "kernel_src",
+                 "host_src",
                  "sync_files", "donation_files",
                  "transferbudget_json", "transfer_files",
                  "lock_files", "future_files", "thread_files",

@@ -237,7 +237,10 @@ def run_header_layout(root: pathlib.Path, overrides=None,
     hpp = overrides.get("chain_hpp", pkg / "core" / "src" / "chain.hpp")
     cpp = overrides.get("chain_cpp", pkg / "core" / "src" / "chain.cpp")
     core_init = overrides.get("core_init", pkg / "core" / "__init__.py")
-    sha_jnp = overrides.get("sha_jnp", pkg / "ops" / "sha256_jnp.py")
+    # NONCE_WORD_INDEX's single source of truth moved to the per-template
+    # precompute module with the extended-midstate refactor (ISSUE 15);
+    # both jax kernels import it from there.
+    sha_jnp = overrides.get("sha_jnp", pkg / "ops" / "sha256_sched.py")
     golden = overrides.get("header_test",
                            root / "tests" / "test_header_layout.py")
 

@@ -37,7 +37,8 @@ LINT_DIRS = ("ops", "models", "parallel")
 # JAX004 scope: the kernels where every BinOp operand IS a SHA word.
 # (models/fused.py does host-side config math like `1 << batch_pow2`, so
 # the literal-operand heuristic would false-positive there.)
-SHA_WORD_MODULES = ("ops/sha256_jnp.py", "ops/sha256_pallas.py")
+SHA_WORD_MODULES = ("ops/sha256_jnp.py", "ops/sha256_pallas.py",
+                    "ops/sha256_sched.py")
 
 DTYPE_CONSTRUCTORS = {
     "uint8", "uint16", "uint32", "uint64", "int8", "int16", "int32",

@@ -297,8 +297,9 @@ def trace_transfer_census() -> dict[str, dict[str, int]]:
     u32 = np.uint32
     multiround, _ = make_multiround_search_fn(
         batch_size=1 << 8, difficulty_bits=12, kernel="jnp")
+    from ..ops.sha256_sched import EXT_WORDS
     flavors["tpu_multiround"] = census(
-        multiround, np.zeros(8, u32), np.zeros(16, u32), u32(0), u32(4))
+        multiround, np.zeros(EXT_WORDS, u32), u32(0), u32(4))
     fused = make_fused_miner(k_blocks=2, batch_pow2=8, difficulty_bits=8,
                              kernel="jnp")
     flavors["fused"] = census(

@@ -40,12 +40,15 @@ def make_multiround_search_fn(batch_size: int, difficulty_bits: int,
     """Builds the jit'd multi-round searcher.
 
     Returns (fn, effective_kernel) where
-    fn(midstate (8,)u32, tail (16,)u32, start u32, n_rounds u32)
+    fn(ext (EXT_WORDS,)u32, start u32, n_rounds u32)
       -> (rounds_done u32, count i32, min_nonce u32)
     sweeps rounds r = 0.. covering [start + r*round_size, +round_size)
     until count > 0 or r == n_rounds (n_rounds is a traced scalar — no
-    recompile per call). count/min_nonce are the LAST executed round's
-    result; min_nonce is 0xFFFFFFFF when count == 0.
+    recompile per call). ``ext`` is the extended-midstate payload
+    (``ops.sha256_sched.extend_midstate`` — the caller precomputes it on
+    the host once per template, so the nonce-invariant rounds/schedule
+    prefix never ride a dispatch). count/min_nonce are the LAST executed
+    round's result; min_nonce is 0xFFFFFFFF when count == 0.
     """
     from ..ops import select_kernel
     from ..parallel.mesh import make_round_search, maybe_shard_over_miners
@@ -99,9 +102,14 @@ class TpuBackend(MinerBackend):
 
     def _search_device(self, header80: bytes, difficulty_bits: int,
                        start_nonce: int, max_count: int) -> SearchResult:
+        from ..ops.sha256_sched import extend_midstate
         from ..parallel.mesh import replicated_host_values
 
+        # Host-side per-template precompute (numpy, no device work): the
+        # chunk-1 midstate plus the nonce-invariant chunk-2 rounds and
+        # schedule prefix, packed for the kernels' scalar-prefetch path.
         midstate, tail = core.header_midstate(header80)
+        ext = extend_midstate(midstate, tail)
         end = min(start_nonce + max_count, NONCE_SPACE)
         round_size = self.batch_size * self.n_miners
         tried = 0
@@ -126,7 +134,7 @@ class TpuBackend(MinerBackend):
             with span("backend.tpu.dispatch",
                       difficulty=difficulty_bits, n_rounds=n_rounds):
                 out = self._searcher(difficulty_bits)(
-                    midstate, tail, np.uint32(base), np.uint32(n_rounds))
+                    ext, np.uint32(base), np.uint32(n_rounds))
                 rounds, count, min_nonce = (
                     int(v) for v in replicated_host_values(out))
             counter("device_dispatches_total",

@@ -200,6 +200,14 @@ def bench_tpu(seconds: float = 5.0, batch_pow2: int = 28,
               "hashes": tried, "wall_s": round(wall, 3),
               "hashes_per_sec": tried / wall,
               "hashes_per_sec_per_chip": tried / wall / n_miners}
+    # The committed op census rides the payload (and so the recorded
+    # PERF_HISTORY entry): GH/s and ops/nonce trend TOGETHER — a rate
+    # regression that coincides with an op-budget cut is attributable
+    # from the history alone, and `perfwatch check` computes utilization
+    # from the census current at record time, never a stale one.
+    census = _committed_census()
+    if census is not None:
+        result["alu_ops_per_nonce"] = census
     if n_miners > 1:
         # Multichip breakdown: every mesh device sweeps exactly `batch`
         # nonces per round (disjoint stripes by construction), so the
@@ -216,6 +224,14 @@ def bench_tpu(seconds: float = 5.0, batch_pow2: int = 28,
              "hashes_per_sec": round(per_chip / wall, 1)}
             for i, dev in enumerate(devices)]
     return result
+
+
+def _committed_census() -> int | None:
+    """alu_ops_per_nonce from the committed OPBUDGET.json, or None."""
+    from .perfwatch.attribution import committed_census
+
+    ops = (committed_census() or {}).get("alu_ops_per_nonce")
+    return ops if isinstance(ops, int) else None
 
 
 def bench_chain(n_blocks: int = 1000, difficulty_bits: int = 24,
@@ -314,9 +330,13 @@ def bench_tpu_single() -> dict:
                                n_blocks=cfg.n_blocks, backend="cpu"),
                    log_fn=lambda d: None)
     oracle.mine_chain()
+    census = _committed_census()
     return {"preset": "tpu-single", "n_blocks": cfg.n_blocks,
             "difficulty_bits": cfg.difficulty_bits,
             "batch_pow2": cfg.batch_pow2, "wall_s": round(wall, 2),
+            # Key omitted (never null) without a committed budget — the
+            # same shape contract as bench_tpu's payload.
+            **({"alu_ops_per_nonce": census} if census is not None else {}),
             "hashes_per_sec": round(miner.hashes_per_sec()),
             "mhs": round(miner.hashes_per_sec() / 1e6, 2),
             "vs_round1_2p83_mhs": round(

@@ -38,6 +38,7 @@ from ..telemetry import counter, heartbeat, histogram
 from ..telemetry.spans import span
 from ..ops.sha256_jnp import (IV, _bswap32, compress,
                               sha256d_words_from_midstate)
+from ..ops.sha256_sched import extend_midstate
 from ..parallel.mesh import replicated_host_value
 
 _U32 = jnp.uint32
@@ -96,8 +97,15 @@ def make_fused_miner(k_blocks: int, batch_pow2: int, difficulty_bits: int,
             [data_words[7], _bswap32(height_u32), jnp.asarray(bits_word),
              jnp.zeros((), _U32), jnp.asarray(np.uint32(0x80000000))]
             + [jnp.zeros((), _U32)] * 10 + [jnp.asarray(np.uint32(640))])
+        # The per-template extended midstate, computed ON-DEVICE once per
+        # block (a few hundred replicated scalar ops, amortized over the
+        # whole sweep): the nonce-invariant chunk-2 rounds + schedule
+        # prefix never run inside the round loop. This is the template
+        # handoff blocktrace's per-height template counter names — one
+        # extension per (height, template).
+        ext = extend_midstate(midstate, tail)
 
-        _, _, nonce = round_search(midstate, tail, np.uint32(0),
+        _, _, nonce = round_search(ext, np.uint32(0),
                                    np.uint32(n_rounds_cap), axis_name)
         # Digest of the winning header = next prev_hash words.
         digest = jnp.stack(sha256d_words_from_midstate(

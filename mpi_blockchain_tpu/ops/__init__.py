@@ -4,15 +4,21 @@ Two implementations of the same op, bit-exact with the C++ core:
   sha256_jnp    — pure jax.numpy, fully XLA-fused (portable: cpu/tpu)
   sha256_pallas — hand-tiled Pallas TPU kernel (VMEM-resident rounds)
 
-Both consume the midstate + chunk-2 word template produced by
-core.header_midstate, so the per-nonce cost is exactly two SHA-256
-compressions everywhere (SURVEY.md §7 step 5 midstate optimization).
+Both consume the EXTENDED midstate produced by
+``sha256_sched.extend_midstate`` from ``core.header_midstate``'s
+(midstate, tail) pair, so the per-nonce cost is the nonce-dependent
+residue of the two SHA-256 compressions: hash 1 enters at round 4 with
+the nonce-invariant schedule prefix precomputed per template, and hash 2
+materializes only the digest words the difficulty mask reads (SURVEY.md
+§7 step 5 midstate optimization, extended per ISSUE 15 / AsicBoost).
 """
 from __future__ import annotations
 
 import functools
 
-from .sha256_jnp import make_sweep_fn, sweep_core, sweep_jnp  # noqa: F401
+from .sha256_jnp import (make_sweep_fn, sweep_core,  # noqa: F401
+                         sweep_core_ext, sweep_jnp)
+from .sha256_sched import EXT_WORDS, extend_midstate  # noqa: F401
 
 
 def select_kernel(kernel: str, batch_size: int, difficulty_bits: int,
@@ -21,12 +27,15 @@ def select_kernel(kernel: str, batch_size: int, difficulty_bits: int,
 
     kernel: {"auto", "jnp", "pallas"}; auto => pallas on a real TPU, jnp
     elsewhere. Returns (fn, effective_kernel_name). With shard=False the fn
-    is jit'd and callable from the host; with shard=True it is the unjitted
-    core (midstate, tail_w, base) -> (count, min_nonce) for use inside
-    shard_map. Only an "auto" choice falls back from pallas to jnp (with a
-    visible warning, so bench labels stay honest); an EXPLICIT "pallas"
-    request that cannot be honored raises ConfigError — a user's explicit
-    choice must never silently degrade.
+    is jit'd and callable from the host as (midstate, tail_w, base_nonce);
+    with shard=True it is the unjitted EXT core (ext, base) ->
+    (count, min_nonce) for use inside shard_map — the caller supplies the
+    extended-midstate payload (``extend_midstate``: once per template on
+    the host in backend/tpu.py, once per block on-device in
+    models/fused.py). Only an "auto" choice falls back from pallas to jnp
+    (with a visible warning, so bench labels stay honest); an EXPLICIT
+    "pallas" request that cannot be honored raises ConfigError — a user's
+    explicit choice must never silently degrade.
 
     early_exit=True (pallas only — the jnp kernel ignores it and sweeps the
     full batch) skips tiles past the first qualifying one: min_nonce stays
@@ -42,7 +51,7 @@ def select_kernel(kernel: str, batch_size: int, difficulty_bits: int,
     if kernel == "pallas":
         try:
             from .sha256_pallas import (TILE, make_pallas_sweep_fn,
-                                        pallas_sweep_core)
+                                        pallas_sweep_core_ext)
             # Eager checks, so bad requests surface here instead of
             # raising mid-trace inside a caller's mine loop: Mosaic can
             # only lower on a real TPU, and batches must tile evenly.
@@ -55,7 +64,7 @@ def select_kernel(kernel: str, batch_size: int, difficulty_bits: int,
                     f"batch_size {batch_size} not a multiple of {TILE}")
             if shard:
                 return functools.partial(
-                    pallas_sweep_core, batch_size=batch_size,
+                    pallas_sweep_core_ext, batch_size=batch_size,
                     difficulty_bits=difficulty_bits,
                     early_exit=early_exit), "pallas"
             return make_pallas_sweep_fn(batch_size, difficulty_bits,
@@ -75,6 +84,6 @@ def select_kernel(kernel: str, batch_size: int, difficulty_bits: int,
     if kernel != "jnp":
         raise ConfigError(f"unknown sweep kernel {kernel!r}")
     if shard:
-        return (lambda ms, tw, base: sweep_core(
-            ms, tw, base, batch_size, difficulty_bits)), "jnp"
+        return (lambda ext, base: sweep_core_ext(
+            ext, base, batch_size, difficulty_bits)), "jnp"
     return make_sweep_fn(batch_size, difficulty_bits), "jnp"

@@ -4,17 +4,25 @@ TPU-first design notes (SURVEY.md §7 step 4):
   * Everything is uint32 vector ALU work on the VPU — there is no matmul in
     SHA-256, so the MXU is idle by construction; the win over the CPU is the
     (8,128)-lane vector unit sweeping a whole nonce batch per instruction.
-  * The 64 rounds x 2 compressions are Python-unrolled at trace time into a
+  * The rounds x 2 compressions are Python-unrolled at trace time into a
     flat chain of elementwise uint32 ops; XLA fuses the entire sweep into one
     kernel, keeping all per-nonce state in registers/VMEM (HBM traffic is just
     the nonce batch in and two scalars out).
   * No data-dependent control flow: a fixed-size batch is swept, reduced to
     (count, min qualifying nonce), and the host decides whether to continue —
     the jit-compatible replacement for the reference's `break` (SURVEY.md §3.4).
+  * Per-nonce work is the EXTENDED-midstate residue (ops/sha256_sched.py):
+    hash 1 enters at round 4 from the per-template round-3 fold (the scan
+    runs 60 rounds, not 64), the nonce-invariant schedule prefix
+    (w16/w17/rc18/rc19) arrives precomputed, and only digest words 0-1 —
+    the only words ``difficulty_mask`` reads — are materialized from the
+    second compression.
 
 Bit-exactness contract: given the midstate/tail from core.header_midstate,
 this computes exactly sha256d(header) for each nonce, matching the C++
-sha256d_from_midstate.
+sha256d_from_midstate (uint32 modular addition is associative, so the
+extended-midstate regrouping is exact; pinned by the cross-flavor
+equivalence fuzz suite in tests/test_kernel_equivalence.py).
 """
 from __future__ import annotations
 
@@ -24,36 +32,16 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-# FIPS 180-4 round constants / IV (same values as core/src/sha256.cpp).
-K = np.array([
-    0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b, 0x59f111f1,
-    0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
-    0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174, 0xe49b69c1, 0xefbe4786,
-    0x0fc19dc6, 0x240ca1cc, 0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da,
-    0x983e5152, 0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
-    0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc, 0x53380d13,
-    0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85, 0xa2bfe8a1, 0xa81a664b,
-    0xc24b8b70, 0xc76c51a3, 0xd192e819, 0xd6990624, 0xf40e3585, 0x106aa070,
-    0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a,
-    0x5b9cca4f, 0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
-    0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2], dtype=np.uint32)
-
-IV = np.array([0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
-               0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19],
-              dtype=np.uint32)
+# Constants + the frozen chunk-2 layout live with the per-template
+# precompute; re-exported here for the existing import surface.
+from .sha256_sched import (CHUNK2_TAIL_CONST, DIGEST_PAD_CONST,  # noqa: F401
+                           EXT_A0, EXT_A1, EXT_A2, EXT_E0, EXT_E1, EXT_E2,
+                           EXT_RC18, EXT_RC19, EXT_RC_A, EXT_RC_E, EXT_W16,
+                           EXT_W17, EXT_WORDS, IV, K, NONCE_WORD_INDEX,
+                           NOT_FOUND_U32, _rotr, _sigma0, _sigma1,
+                           extend_midstate)
 
 _U32 = jnp.uint32
-NOT_FOUND_U32 = np.uint32(0xFFFFFFFF)
-
-# The nonce's position in the header's second SHA-256 chunk: byte offset
-# 76 of the frozen layout (chain.hpp) = 64 + NONCE_WORD_INDEX * 4. Both
-# device kernels substitute the swept nonce at this word; chainlint HDR004
-# cross-checks the value against the C++ struct layout.
-NONCE_WORD_INDEX = 3
-
-
-def _rotr(x, n: int):
-    return (x >> np.uint32(n)) | (x << np.uint32(32 - n))
 
 
 def _bswap32(x):
@@ -63,25 +51,39 @@ def _bswap32(x):
          | (x >> np.uint32(24))
 
 
-def compress(state, w, unroll: int = 8):
-    """One SHA-256 compression.
+def compress(state, w, unroll: int = 8, rounds=None, feedforward=None,
+             vzero_index: int = 3, out_words: int = 8):
+    """One SHA-256 compression (optionally a round suffix of one).
 
     state: tuple/list of 8 uint32 arrays, all of one shape B
     w:     list of 16 uint32 arrays (message words), each of shape B
-    Returns the 8 updated state words.
+    rounds: the K-slice to scan (default the full 64). A suffix call
+            passes ``K[4:]`` with ``w`` aligned at word 4 — the rotating
+            window is position-relative, so the same scan body serves
+            both (the extended-midstate path enters at round 4).
+    feedforward: the 8 words added after the last round (SHA's
+            feed-forward). Defaults to ``state``; a suffix call passes
+            the ORIGINAL midstate, which is not the entry state.
+    vzero_index: which w word donates the varying-zero used to align
+            the scan carry's varying-axes type under shard_map (must
+            name a nonce-dependent word: 3 for a full compression over
+            a chunk-2 template, 15 (= w19) for the suffix call).
+    out_words: leading digest words to return (2 = just h0/h1, all the
+            difficulty mask reads).
+    Returns the ``out_words`` updated state words.
 
-    Implemented as two lax.scans (message schedule, then the 64 rounds) so
-    the traced graph stays tiny: a fully Python-unrolled version takes XLA's
-    CPU backend minutes to compile. `unroll` gives XLA straight-line chunks
-    to software-pipeline without exploding the graph.
+    Implemented as one lax.scan so the traced graph stays tiny: a fully
+    Python-unrolled version takes XLA's CPU backend minutes to compile.
+    `unroll` gives XLA straight-line chunks to software-pipeline without
+    exploding the graph.
     """
-    shape = jnp.shape(w[3]) if jnp.ndim(w[3]) else ()
+    shape = jnp.shape(w[vzero_index]) if jnp.ndim(w[vzero_index]) else ()
     W16 = jnp.stack([jnp.broadcast_to(jnp.asarray(x, _U32), shape)
                      for x in w])  # (16, *B)
     # Under shard_map the nonce word varies over the mesh axis while the
     # midstate/IV are replicated; xor-ing a varying zero into the scan carry
     # makes its varying-axes type match the per-round outputs.
-    vzero = W16[3] & np.uint32(0)
+    vzero = W16[vzero_index] & np.uint32(0)
 
     # One scan fuses the message schedule into the rounds with a rotating
     # 16-word window (window[k] == w[round+k]), so the live state per nonce
@@ -97,18 +99,17 @@ def compress(state, w, unroll: int = 8):
         maj = (a & b) ^ (a & c) ^ (b & c)
         t2 = S0 + maj
         # Schedule: w[r+16] = w[r] + s0(w[r+1]) + w[r+9] + s1(w[r+14]).
-        w1, w14 = window[1], window[14]
-        s0 = _rotr(w1, 7) ^ _rotr(w1, 18) ^ (w1 >> np.uint32(3))
-        s1 = _rotr(w14, 17) ^ _rotr(w14, 19) ^ (w14 >> np.uint32(10))
-        nxt = wi + s0 + window[9] + s1
+        nxt = wi + _sigma0(window[1]) + window[9] + _sigma1(window[14])
         window = jnp.concatenate([window[1:], nxt[None]], axis=0)
         return (window, (t1 + t2, a, b, c, d + t1, e, f, g)), None
 
+    ks = jnp.asarray(K if rounds is None else rounds, _U32)
     st = tuple(jnp.broadcast_to(jnp.asarray(s, _U32), shape) ^ vzero
                for s in state)
-    (_, out), _ = jax.lax.scan(round_step, (W16, st), jnp.asarray(K, _U32),
-                               unroll=unroll)
-    return tuple(o + s for o, s in zip(out, st))
+    (_, out), _ = jax.lax.scan(round_step, (W16, st), ks, unroll=unroll)
+    ff = state if feedforward is None else feedforward
+    return tuple(o + jnp.asarray(s, _U32)
+                 for o, s in zip(out[:out_words], ff))
 
 
 def sha256d_words_from_midstate(midstate, tail_w, nonce_word):
@@ -127,18 +128,42 @@ def sha256d_words_from_midstate(midstate, tail_w, nonce_word):
     d1 = compress(st, w)
     # Second hash: digest-1 words are the message words directly (the digest
     # bytes are their BE encoding, and SHA reads words BE — no swap).
-    zero = np.uint32(0)
-    w2 = list(d1) + [np.uint32(0x80000000),
-                     zero, zero, zero, zero, zero, zero,
-                     np.uint32(32 * 8)]
+    w2 = list(d1) + [np.uint32(v) for v in DIGEST_PAD_CONST]
     return compress(tuple(IV), w2)
+
+
+def sha256d_h01_from_ext(ext, nonce_word):
+    """Digest words h0, h1 — all ``difficulty_mask`` reads — from the
+    extended midstate (``sha256_sched.extend_midstate``).
+
+    Hash 1 runs only its 60-round residue: round 3 is the two folded
+    adds ``rc_a + w3`` / ``rc_e + w3``, the window enters at word 4 with
+    the precomputed w16/w17 and the rc18/rc19 partial sums, and the scan
+    consumes K[4:]. Hash 2 is a full compression of the 8 digest words
+    but materializes only its first two feed-forward outputs.
+    """
+    w3 = nonce_word
+    a3 = ext[EXT_RC_A] + w3
+    e3 = ext[EXT_RC_E] + w3
+    w18 = ext[EXT_RC18] + _sigma0(w3)
+    w19 = w3 + ext[EXT_RC19]
+    window = [np.uint32(v) for v in CHUNK2_TAIL_CONST] \
+        + [ext[EXT_W16], ext[EXT_W17], w18, w19]
+    st4 = (a3, ext[EXT_A2], ext[EXT_A1], ext[EXT_A0],
+           e3, ext[EXT_E2], ext[EXT_E1], ext[EXT_E0])
+    d1 = compress(st4, window, rounds=K[4:],
+                  feedforward=[ext[i] for i in range(8)], vzero_index=15)
+    w2 = list(d1) + [np.uint32(v) for v in DIGEST_PAD_CONST]
+    return compress(tuple(IV), w2, out_words=2)
 
 
 def difficulty_mask(digest_words, difficulty_bits: int):
     """True where the 256-bit BE digest has >= difficulty_bits leading zeros.
 
     difficulty_bits is static (compiled per difficulty). Supports 0..64,
-    which covers every BASELINE config (max 24) with headroom.
+    which covers every BASELINE config (max 24) with headroom. Only
+    digest words 0-1 are ever read — the early-exit contract the
+    kernels' second compression is specialized around.
     """
     h0, h1 = digest_words[0], digest_words[1]
     d = int(difficulty_bits)
@@ -156,24 +181,36 @@ def difficulty_mask(digest_words, difficulty_bits: int):
     raise ConfigError(f"difficulty_bits {d} > 64 unsupported")
 
 
+def sweep_core_ext(ext, base_nonce, batch_size: int, difficulty_bits: int):
+    """Sweeps nonces [base_nonce, base_nonce + batch_size) from an
+    extended-midstate payload (``sha256_sched.extend_midstate``).
+    Unjitted; same (count, min_nonce) contract as ``sweep_core``.
+    Callable inside jit, vmap, or shard_map (the mesh winner-select
+    wraps exactly this)."""
+    nonces = jnp.asarray(base_nonce).astype(_U32) \
+        + jnp.arange(batch_size, dtype=_U32)
+    h01 = sha256d_h01_from_ext(jnp.asarray(ext).astype(_U32),
+                               _bswap32(nonces))
+    qual = difficulty_mask(h01, difficulty_bits)
+    count = jnp.sum(qual.astype(jnp.int32))
+    min_nonce = jnp.min(jnp.where(qual, nonces, NOT_FOUND_U32))
+    return count, min_nonce
+
+
 def sweep_core(midstate, tail_w, base_nonce, batch_size: int,
                difficulty_bits: int):
     """Sweeps nonces [base_nonce, base_nonce + batch_size). Unjitted.
 
     Returns (count, min_nonce): number of qualifying nonces in the batch and
     the lowest one (0xFFFFFFFF when count == 0 — disambiguated by count, so
-    the real nonce 0xFFFFFFFF is handled correctly). Callable inside jit,
-    vmap, or shard_map (the mesh winner-select wraps exactly this).
+    the real nonce 0xFFFFFFFF is handled correctly). Convenience wrapper
+    that extends the midstate inline; the production paths extend once per
+    template (host: backend/tpu.py, device: models/fused.py) and call
+    ``sweep_core_ext`` directly.
     """
-    nonces = jnp.asarray(base_nonce).astype(_U32) \
-        + jnp.arange(batch_size, dtype=_U32)
-    digest = sha256d_words_from_midstate(jnp.asarray(midstate).astype(_U32),
-                                         jnp.asarray(tail_w).astype(_U32),
-                                         _bswap32(nonces))
-    qual = difficulty_mask(digest, difficulty_bits)
-    count = jnp.sum(qual.astype(jnp.int32))
-    min_nonce = jnp.min(jnp.where(qual, nonces, NOT_FOUND_U32))
-    return count, min_nonce
+    ext = extend_midstate(jnp.asarray(midstate).astype(_U32),
+                          jnp.asarray(tail_w).astype(_U32))
+    return sweep_core_ext(ext, base_nonce, batch_size, difficulty_bits)
 
 
 @functools.partial(jax.jit, static_argnames=("batch_size", "difficulty_bits"))

@@ -11,18 +11,37 @@ kernel playbook:
   * Both compressions are fully unrolled straight-line vector code (Mosaic
     compiles this quickly, unlike the XLA CPU backend) with the rotating
     16-word schedule window, so the live set is ~24 (ROWS,128) u32 registers.
-  * The chunk-1 midstate and the constant chunk-2 words arrive via scalar
-    prefetch (SMEM); only the nonce word varies per lane.
+  * The EXTENDED midstate (ops/sha256_sched.py) arrives via scalar prefetch
+    (SMEM): chunk-1 midstate, the nonce-invariant state entering round 4,
+    the folded round-3 constants, and the w16/w17/rc18/rc19 schedule
+    prefix. Hash 1 therefore runs a 60-round residue; only the nonce word
+    varies per lane.
+  * Uniform terms are summed BEFORE vector terms everywhere (``_usum``):
+    template scalars stay on the scalar core, compile-time chunk-2/padding
+    constants fold at trace time (numpy), and a uniform sum that folds to
+    exactly zero is elided rather than added.
+  * The second compression materializes only digest words 0-1 — all
+    ``difficulty_mask`` reads — so its final round skips the e-chain
+    update and six of the eight feed-forward adds (h1's add is also
+    elided when difficulty_bits <= 32 never reads it).
 
-Bit-exactness: identical round structure to core/src/sha256.cpp
-(sha256d_from_midstate); verified against the C++ oracle in
-tests/test_pallas.py and, on real TPU, by the backend-equivalence suite.
+The per-nonce op count of ``_tile_result`` is a committed, ratcheted
+budget: OPBUDGET.json pins the traced jaxpr census (the scoreboard
+``experiments/roofline.py --write-budget`` moves) and chainlint's
+``opbudget`` pass recomputes a static proxy on every run. Edits that add
+vector work here fail `make check` until the budget diff is reviewed.
+
+Bit-exactness: identical round algebra to core/src/sha256.cpp
+(sha256d_from_midstate) — uint32 modular addition is associative, so the
+uniform-first regrouping is exact; verified against the C++ oracle in
+tests/test_pallas.py, tests/test_kernel_equivalence.py and, on real TPU,
+by the backend-equivalence suite.
 
 Measured scaling (v5e single chip, axon tunnel, 2026-07-29): dispatch
 overhead dominates below ~2^26 nonces/dispatch (2^20 ≈ 12 MH/s, 2^22 ≈
 50 MH/s); the kernel saturates the VPU from 2^26 up (967 MH/s at 2^28 with
-this round algebra). Callers that care about throughput must batch big —
-see bench.py — or stay device-resident (models/fused.py).
+the round-4 round algebra). Callers that care about throughput must batch
+big — see bench.py — or stay device-resident (models/fused.py).
 """
 from __future__ import annotations
 
@@ -34,7 +53,11 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from .sha256_jnp import IV, K, NONCE_WORD_INDEX, NOT_FOUND_U32
+from .sha256_jnp import IV, K, NOT_FOUND_U32
+from .sha256_sched import (CHUNK2_TAIL_CONST, DIGEST_PAD_CONST, EXT_A0,
+                           EXT_A1, EXT_A2, EXT_E0, EXT_E1, EXT_E2, EXT_RC18,
+                           EXT_RC19, EXT_RC_A, EXT_RC_E, EXT_W16, EXT_W17,
+                           EXT_WORDS, extend_midstate)
 
 _U32 = jnp.uint32
 _LANES = 128
@@ -53,10 +76,45 @@ def _bswap32(x):
          | (x >> np.uint32(24))
 
 
-def _compress_unrolled(state, w):
-    """64 unrolled SHA-256 rounds with a rotating schedule window.
+def _sigma0(x):
+    return _rotr(x, 7) ^ _rotr(x, 18) ^ (x >> np.uint32(3))
 
-    state: tuple of 8 (ROWS,128) u32; w: list of 16 (ROWS,128) u32.
+
+def _sigma1(x):
+    return _rotr(x, 17) ^ _rotr(x, 19) ^ (x >> np.uint32(10))
+
+
+def _usum(*terms):
+    """Sum of uint32 terms, uniform terms first — the constant-folding
+    seam of the kernel. Uniform (0-d: numpy constants and SMEM scalar
+    reads) terms are summed before any vector term joins, so all-uniform
+    partial sums fold at trace time or stay on the scalar core, and each
+    vector term costs exactly one (ROWS, LANES) add; a uniform partial
+    sum that is concretely zero is skipped outright. Exact under uint32
+    modular arithmetic (addition is associative and commutative)."""
+    uni = [t for t in terms if np.ndim(t) == 0
+           and not (isinstance(t, (int, np.integer)) and int(t) == 0)]
+    vec = [t for t in terms if np.ndim(t) != 0]
+    acc = None
+    for t in uni:
+        acc = t if acc is None else acc + t
+    if acc is not None and isinstance(acc, (int, np.integer)) \
+            and int(acc) == 0:
+        acc = None
+    for t in vec:
+        acc = t if acc is None else acc + t
+    return np.uint32(0) if acc is None else acc
+
+
+def _h1_tail_rounds(state, w):
+    """Rounds 4..63 of the chunk-2 compression from the extended
+    midstate (rounds 0..3 are per-template precompute).
+
+    state: the 8 state words entering round 4 — a3/e3 vector, the rest
+    uniform SMEM scalars; w: the 16-word window aligned at word 4
+    (w[i - 4] == W[i]), expansions appended in place. Returns the 8
+    post-round-63 words WITHOUT the feed-forward add (the caller adds
+    the original midstate — the entry state here is not it).
 
     Round-function algebra (measured +4% at the 2^28-batch VPU plateau):
       * ch(e,f,g)  = g ^ (e & (f ^ g))          — 3 ops vs 4
@@ -65,62 +123,105 @@ def _compress_unrolled(state, w):
       * w[r+16] is only expanded while some future round consumes it
         (r+16 < 64); the classic rotating window wastes 16 expansions.
     """
-    window = list(w)
     a, b, c, d, e, f, g, h = state
     ab_prev = None
     # errstate: uniform inputs are numpy scalars whose modular uint32 adds
     # fold at trace time; the wraparound is the algorithm, not an error.
     with np.errstate(over="ignore"):
-        for r in range(64):
-            wi = window[r]
+        for r in range(4, 64):
+            wi = w[r - 4]
             S1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
             ch = g ^ (e & (f ^ g))
-            t1 = h + S1 + ch + np.uint32(K[r]) + wi
+            t1 = _usum(h, S1, ch, np.uint32(K[r]), wi)
             S0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
             ab = a ^ b
             bc = (b ^ c) if ab_prev is None else ab_prev
             maj = b ^ (ab & bc)
             ab_prev = ab
-            t2 = S0 + maj
-            h, g, f, e = g, f, e, d + t1
-            d, c, b, a = c, b, a, t1 + t2
-            # w[r+16] = w[r] + s0(w[r+1]) + w[r+9] + s1(w[r+14])
+            t2 = _usum(S0, maj)
+            h, g, f, e = g, f, e, _usum(d, t1)
+            d, c, b, a = c, b, a, _usum(t1, t2)
+            # W[r+16] = W[r] + s0(W[r+1]) + W[r+9] + s1(W[r+14])
             if r + 16 < 64:
-                w1, w14 = window[r + 1], window[r + 14]
-                s0 = _rotr(w1, 7) ^ _rotr(w1, 18) ^ (w1 >> np.uint32(3))
-                s1 = _rotr(w14, 17) ^ _rotr(w14, 19) ^ (w14 >> np.uint32(10))
-                window.append(wi + s0 + window[r + 9] + s1)
-        out = (a, b, c, d, e, f, g, h)
-        return tuple(o + s for o, s in zip(out, state))
+                w.append(_usum(wi, _sigma0(w[r - 3]), w[r + 5],
+                               _sigma1(w[r + 10])))
+        return a, b, c, d, e, f, g, h
 
 
-def _tile_result(midstate_ref, tail_ref, base, *, difficulty_bits: int):
+def _h2_digest_h01(d1, *, need_h1: bool):
+    """The second compression, specialized to digest words 0-1 — all the
+    difficulty mask ever reads (h0 = a63 + IV[0], h1 = a62 + IV[1]).
+
+    The a-chain's last two values are the LAST thing the compression
+    produces, so every round still runs — but the final round's e-chain
+    update exists only for h4..h7 and is elided, as are the feed-forward
+    adds of words 2..7 (and word 1's when difficulty_bits <= 32 never
+    reads h1). Message: the 8 digest-1 words + the fixed 256-bit padding
+    (compile-time constants, so the early rounds' uniform terms and the
+    schedule's constant expansion terms fold at trace time).
+    """
+    w = list(d1) + [np.uint32(v) for v in DIGEST_PAD_CONST]
+    a, b, c, d, e, f, g, h = (np.uint32(v) for v in IV)
+    ab_prev = None
+    a_prev = None
+    with np.errstate(over="ignore"):
+        for r in range(64):
+            wi = w[r]
+            S1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+            ch = g ^ (e & (f ^ g))
+            t1 = _usum(h, S1, ch, np.uint32(K[r]), wi)
+            S0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+            ab = a ^ b
+            bc = (b ^ c) if ab_prev is None else ab_prev
+            maj = b ^ (ab & bc)
+            ab_prev = ab
+            t2 = _usum(S0, maj)
+            if r < 63:
+                h, g, f, e = g, f, e, _usum(d, t1)
+                d, c, b, a = c, b, a, _usum(t1, t2)
+            else:
+                a_prev = a                      # a62 — feeds h1 only
+                a = _usum(t1, t2)               # a63 — feeds h0
+            if r + 16 < 64:
+                w.append(_usum(wi, _sigma0(w[r + 1]), w[r + 9],
+                               _sigma1(w[r + 14])))
+        h0 = _usum(a, np.uint32(IV[0]))
+        h1 = _usum(a_prev, np.uint32(IV[1])) if need_h1 else None
+    return h0, h1
+
+
+def _tile_result(ext_ref, base, *, difficulty_bits: int):
     """(count, biased_min) for the 8192-nonce tile starting at base.
 
-    Uniform words stay SCALAR (SMEM values / numpy constants) — only the
-    nonce word is a vector. jnp promotion then keeps every all-uniform
-    intermediate on the scalar core: rounds 0-2 of hash 1 (the nonce enters
-    at round 3), the uniform terms of the message schedule, and hash 2's
-    constant padding words cost no VPU work, and numpy folds the
-    all-constant parts at trace time.
+    ext_ref holds the EXT_WORDS-word extended midstate
+    (sha256_sched.extend_midstate) as uniform SMEM scalars — only the
+    nonce word is a vector. jnp promotion plus ``_usum``'s uniform-first
+    grouping keep every all-uniform intermediate on the scalar core and
+    fold the all-constant parts at trace time; what remains is the
+    per-nonce residue OPBUDGET.json budgets.
     """
     row = jax.lax.broadcasted_iota(_U32, (_ROWS, _LANES), 0)
     lane = jax.lax.broadcasted_iota(_U32, (_ROWS, _LANES), 1)
     nonces = base + row * np.uint32(_LANES) + lane
 
-    # Chunk 2 of the first hash: uniform words from SMEM, nonce in word 3.
-    w1 = [tail_ref[i] if i != NONCE_WORD_INDEX else _bswap32(nonces)
-          for i in range(16)]
-    st1 = tuple(midstate_ref[i] for i in range(8))
-    d1 = _compress_unrolled(st1, w1)
-    # Second hash: one padded chunk whose first 8 words are digest 1.
-    w2 = list(d1) + [np.uint32(0x80000000)] \
-        + [np.uint32(0)] * 6 + [np.uint32(256)]
-    st2 = tuple(np.uint32(v) for v in IV)
-    d2 = _compress_unrolled(st2, w2)
+    with np.errstate(over="ignore"):
+        # Chunk 2 of the first hash, from round 4: round 3 is the two
+        # folded adds, the schedule prefix arrives precomputed.
+        w3 = _bswap32(nonces)
+        a3 = _usum(ext_ref[EXT_RC_A], w3)
+        e3 = _usum(ext_ref[EXT_RC_E], w3)
+        w18 = _usum(ext_ref[EXT_RC18], _sigma0(w3))
+        w19 = _usum(w3, ext_ref[EXT_RC19])
+        window = [np.uint32(v) for v in CHUNK2_TAIL_CONST] \
+            + [ext_ref[EXT_W16], ext_ref[EXT_W17], w18, w19]
+        st4 = (a3, ext_ref[EXT_A2], ext_ref[EXT_A1], ext_ref[EXT_A0],
+               e3, ext_ref[EXT_E2], ext_ref[EXT_E1], ext_ref[EXT_E0])
+        out = _h1_tail_rounds(st4, window)
+        # Feed-forward against the ORIGINAL chunk-1 midstate.
+        d1 = [_usum(o, ext_ref[i]) for i, o in enumerate(out)]
+        h0, h1 = _h2_digest_h01(d1, need_h1=difficulty_bits > 32)
 
     # Leading-zero-bits difficulty check on the big-endian digest.
-    h0, h1 = d2[0], d2[1]
     dbits = int(difficulty_bits)
     if dbits <= 0:
         qual = jnp.ones_like(h0, dtype=jnp.bool_)
@@ -144,7 +245,7 @@ def _tile_result(midstate_ref, tail_ref, base, *, difficulty_bits: int):
     return count, jnp.min(biased)
 
 
-def _sweep_kernel(midstate_ref, tail_ref, base_ref, count_ref, min_ref, *,
+def _sweep_kernel(ext_ref, base_ref, count_ref, min_ref, *,
                   difficulty_bits: int, early_exit: bool):
     """Grid sweep: one tile per program, sequential on the core.
 
@@ -161,8 +262,7 @@ def _sweep_kernel(midstate_ref, tail_ref, base_ref, count_ref, min_ref, *,
 
     def tile():
         base = base_ref[0] + (pid * np.uint32(TILE)).astype(_U32)
-        c, m = _tile_result(midstate_ref, tail_ref, base,
-                            difficulty_bits=difficulty_bits)
+        c, m = _tile_result(ext_ref, base, difficulty_bits=difficulty_bits)
         count_ref[0, 0] += c
         min_ref[0, 0] = jnp.minimum(min_ref[0, 0], m)
 
@@ -192,16 +292,18 @@ def _out_vma(*xs) -> frozenset:
                                for x in xs))
 
 
-def pallas_sweep_core(midstate, tail_w, base_nonce, *, batch_size: int,
-                      difficulty_bits: int, interpret: bool = False,
-                      early_exit: bool = False):
-    """Sweeps [base_nonce, base_nonce + batch_size) on one TPU core.
+def pallas_sweep_core_ext(ext, base_nonce, *, batch_size: int,
+                          difficulty_bits: int, interpret: bool = False,
+                          early_exit: bool = False):
+    """Sweeps [base_nonce, base_nonce + batch_size) on one TPU core from
+    an (EXT_WORDS,) extended-midstate payload.
 
-    Same contract as sha256_jnp.sweep_core: returns (count, min_nonce).
-    batch_size must be a multiple of the 8192-nonce tile. With
-    early_exit=True, tiles after the first qualifying tile are skipped:
-    min_nonce is unchanged (lowest-nonce determinism holds) but count is
-    only exact up to that tile — use where count is just a found-flag.
+    Same contract as sha256_jnp.sweep_core_ext: returns (count,
+    min_nonce). batch_size must be a multiple of the 8192-nonce tile.
+    With early_exit=True, tiles after the first qualifying tile are
+    skipped: min_nonce is unchanged (lowest-nonce determinism holds) but
+    count is only exact up to that tile — use where count is just a
+    found-flag.
     """
     if batch_size % TILE != 0:
         raise ValueError(f"batch_size {batch_size} not a multiple of {TILE}")
@@ -218,7 +320,7 @@ def pallas_sweep_core(midstate, tail_w, base_nonce, *, batch_size: int,
                                early_exit=early_exit)
     grid = (n_tiles,)
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,      # midstate, tail, base — all SMEM scalars
+        num_scalar_prefetch=2,      # extended midstate, base — SMEM scalars
         grid=grid,
         in_specs=[],
         out_specs=[
@@ -228,12 +330,11 @@ def pallas_sweep_core(midstate, tail_w, base_nonce, *, batch_size: int,
                          memory_space=pltpu.SMEM),
         ],
     )
-    ms = jnp.asarray(midstate, _U32)
-    tw = jnp.asarray(tail_w, _U32)
+    xt = jnp.asarray(ext, _U32)
     bn = jnp.asarray(base_nonce, _U32).reshape((1,))
     # Only pass the kwarg when non-empty, so JAX versions without
     # ShapeDtypeStruct(vma=...) keep working outside shard_map.
-    vma = _out_vma(ms, tw, bn)
+    vma = _out_vma(xt, bn)
     vma_kw = {"vma": vma} if vma else {}
     count, min_biased = pl.pallas_call(
         kernel,
@@ -241,10 +342,26 @@ def pallas_sweep_core(midstate, tail_w, base_nonce, *, batch_size: int,
                    jax.ShapeDtypeStruct((1, 1), jnp.int32, **vma_kw)],
         grid_spec=grid_spec,
         interpret=interpret,
-    )(ms, tw, bn)
+    )(xt, bn)
     min_nonce = jax.lax.bitcast_convert_type(
         min_biased[0, 0], _U32) ^ np.uint32(0x80000000)
     return count[0, 0], min_nonce
+
+
+def pallas_sweep_core(midstate, tail_w, base_nonce, *, batch_size: int,
+                      difficulty_bits: int, interpret: bool = False,
+                      early_exit: bool = False):
+    """(midstate, tail) convenience flavor of ``pallas_sweep_core_ext``:
+    extends the midstate inline (numpy callers fold it on the host at
+    trace time; traced callers pay a handful of scalar ops per dispatch).
+    The production paths extend once per template and call the ext core
+    directly (host: backend/tpu.py, device: models/fused.py)."""
+    if batch_size % TILE != 0:
+        raise ValueError(f"batch_size {batch_size} not a multiple of {TILE}")
+    ext = extend_midstate(midstate, tail_w)
+    return pallas_sweep_core_ext(ext, base_nonce, batch_size=batch_size,
+                                 difficulty_bits=difficulty_bits,
+                                 interpret=interpret, early_exit=early_exit)
 
 
 def make_pallas_sweep_fn(batch_size: int, difficulty_bits: int,

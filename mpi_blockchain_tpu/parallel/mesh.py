@@ -164,21 +164,25 @@ def make_round_search(sweep, batch_size: int, round_size: int):
     """The multi-round device search loop, shared by the per-block searcher
     (backend/tpu.py) and the fused miner (models/fused.py).
 
-    Returns run(midstate (8,)u32, tail_w (16,)u32, start u32, n_rounds u32,
+    Returns run(ext (EXT_WORDS,)u32, start u32, n_rounds u32,
     axis_name=None) -> (rounds_done u32, count i32, min_nonce u32): a
     lax.while_loop over ascending rounds r covering [start + r*round_size,
     +round_size) that exits at the first round containing a qualifier.
-    count/min_nonce are the LAST executed round's result (min_nonce ==
-    0xFFFFFFFF when count == 0); rounds ascend, so the winner is the exact
-    global lowest qualifying nonce — the determinism contract. n_rounds is
-    a traced scalar: one compile serves any round budget.
+    ``ext`` is the per-template extended-midstate payload
+    (``ops.sha256_sched.extend_midstate``) — hoisted OUTSIDE the round
+    loop by construction, so the nonce-invariant precompute is paid once
+    per template, never per round. count/min_nonce are the LAST executed
+    round's result (min_nonce == 0xFFFFFFFF when count == 0); rounds
+    ascend, so the winner is the exact global lowest qualifying nonce —
+    the determinism contract. n_rounds is a traced scalar: one compile
+    serves any round budget.
     """
     # round_size == 2^32 (one round = the whole nonce space) is a legal
     # config whose multiplier overflows uint32; masked it becomes 0, which
     # stays correct because the only executable round is then r == 0.
     round_size_u32 = np.uint32(round_size & 0xFFFFFFFF)
 
-    def run(midstate, tail_w, start, n_rounds, axis_name=None):
+    def run(ext, start, n_rounds, axis_name=None):
         def cond(s):
             r, c, _ = s
             return (c == 0) & (r < n_rounds)
@@ -187,12 +191,12 @@ def make_round_search(sweep, batch_size: int, round_size: int):
             r, _, _ = s
             base = (jnp.asarray(start).astype(_U32) + r * round_size_u32)
             if axis_name is not None:
-                c, mn = sweep(midstate, tail_w,
+                c, mn = sweep(ext,
                               sharded_local_base(base, batch_size,
                                                  axis_name))
                 c, mn = winner_select(c, mn, axis_name)
             else:
-                c, mn = sweep(midstate, tail_w, base)
+                c, mn = sweep(ext, base)
             return r + np.uint32(1), c, mn
 
         from ..ops.sha256_jnp import NOT_FOUND_U32
@@ -263,17 +267,23 @@ def make_mesh_sweep_fn(mesh: Mesh, batch_size: int, difficulty_bits: int,
 
     All inputs are replicated; outputs are replicated scalars (the collective
     epilogue reduces across 'miners'). One XLA program per round — the entire
-    mine-round including the "MPI" step is a single device computation.
+    mine-round including the "MPI" step is a single device computation. The
+    extended-midstate precompute (``ops.sha256_sched.extend_midstate``)
+    runs once per call on replicated scalars, outside the shard_map.
     """
-    from ..ops import select_kernel
+    from ..ops import extend_midstate, select_kernel
 
     sweep, _ = select_kernel(kernel, batch_size, difficulty_bits, shard=True)
 
-    def per_device(midstate, tail_w, base):
-        count, min_nonce = sweep(midstate, tail_w,
-                                 sharded_local_base(base, batch_size))
+    def per_device(ext, base):
+        count, min_nonce = sweep(ext, sharded_local_base(base, batch_size))
         return winner_select(count, min_nonce)
 
     sharded = shard_map(per_device, mesh=mesh,
-                        in_specs=(P(), P(), P()), out_specs=(P(), P()))
-    return jax.jit(sharded)
+                        in_specs=(P(), P()), out_specs=(P(), P()))
+
+    def fn(midstate, tail_w, base):
+        return sharded(extend_midstate(jnp.asarray(midstate, _U32),
+                                       jnp.asarray(tail_w, _U32)), base)
+
+    return jax.jit(fn)

@@ -83,6 +83,27 @@ def cmd_record(args) -> int:
     return 0
 
 
+def _current_roofline(store: HistoryStore) -> dict | None:
+    """Utilization of the newest recorded sweep rate at the COMMITTED
+    op census (OPBUDGET.json next to the history file, falling back to
+    the repo root) — the post-cut roofline, not whatever census was
+    current when the entry was recorded."""
+    from .attribution import committed_census, utilization
+
+    sweeps = store.entries("sweep")
+    if not sweeps:
+        return None
+    budget = committed_census(store.path.parent) \
+        or committed_census()
+    ops = (budget or {}).get("alu_ops_per_nonce")
+    if not isinstance(ops, int):
+        return None
+    # Ties on recorded_at fall back to file order (append order).
+    newest = max(enumerate(sweeps),
+                 key=lambda t: (t[1].recorded_at, t[0]))[1]
+    return utilization(newest.value, ops)
+
+
 def cmd_check(args) -> int:
     store = _store(args)
     if args.candidate:
@@ -102,16 +123,31 @@ def cmd_check(args) -> int:
         findings = check_history(store, threshold_pct=args.threshold_pct,
                                  k=args.k)
     bad = regressions(findings)
+    # Utilization is reported against the CURRENT committed op census
+    # (OPBUDGET.json), never the census that happened to be live when a
+    # history record was written: after an op-budget cut the same
+    # measured rate sits lower on the roofline, and the stale recorded
+    # `utilization` payloads must not mask that headroom.
+    roofline = _current_roofline(store)
     try:
         if args.as_json:
-            print(json.dumps({"event": "perfwatch_check",
-                              "history": str(store.path),
-                              "regressions": len(bad),
-                              "findings": [f.to_dict() for f in findings]},
-                             sort_keys=True))
+            doc = {"event": "perfwatch_check",
+                   "history": str(store.path),
+                   "regressions": len(bad),
+                   "findings": [f.to_dict() for f in findings]}
+            if roofline:
+                doc["roofline"] = roofline
+            print(json.dumps(doc, sort_keys=True))
         else:
             for f in findings:
                 print(f.render())
+            if roofline:
+                print(f"perfwatch: newest sweep "
+                      f"{roofline['measured_mhs']:.1f} MH/s = "
+                      f"{roofline['vpu_utilization_pct']}% of the VPU "
+                      f"roofline at the committed census "
+                      f"({roofline['alu_ops_per_nonce']} ALU ops/nonce)",
+                      file=sys.stderr)
             print(f"perfwatch: {len(bad)} regression(s) across "
                   f"{len(findings)} series", file=sys.stderr)
     except BrokenPipeError:

@@ -61,6 +61,168 @@ def utilization(measured_hashes_per_s: float,
     }
 
 
+def committed_census(root=None) -> dict | None:
+    """The committed OPBUDGET.json budget dict, or None when absent or
+    unreadable. ``root`` defaults to the repo root (two levels above this
+    package). The sweep benches stamp ``alu_ops_per_nonce`` from here
+    into their payloads, and ``perfwatch check`` reports utilization
+    against THIS census — never a stale value baked into an old history
+    record."""
+    import json
+    import pathlib
+
+    root = pathlib.Path(root) if root is not None else \
+        pathlib.Path(__file__).resolve().parent.parent.parent
+    try:
+        data = json.loads((root / "OPBUDGET.json").read_text())
+    except (OSError, ValueError):
+        return None
+    return data if isinstance(data, dict) else None
+
+
+# ---- the per-nonce op closed form (extended-midstate kernel) --------------
+#
+# The kernel's per-nonce cost re-derived from first principles, mirroring
+# exactly what ops/sha256_pallas.py emits after the ISSUE 15 cuts
+# (extended midstate, uniform-first folded sums, h0/h1-only second
+# compression). Values are modeled only by their uniformity class; an op
+# counts one ALU slot per nonce iff its RESULT is nonce-varying — the
+# same rule the traced census applies to tile-shaped jaxpr eqns, so
+# kernel_op_model() == count_tile_ops()["alu_ops_per_nonce"] exactly
+# (pinned by test). This is also the ISA floor argument: on a VPU with
+# 2-operand shifts/bitops and no rotate or ternary-bitwise instruction,
+#   rotr        = shift + shift + or            -> 3 ops (bit-disjoint
+#                 halves; no multiply/add trick can fuse them, carries
+#                 corrupt overlapping shifted copies)
+#   Sigma0/1    = 3 rotations + 2 combines      -> 11 ops (rotation
+#                 composition shares no shifts: all 6 shifted copies of
+#                 e are distinct and each costs one instruction)
+#   sigma0/1    = 2 rotations + 1 shift + 2 xor -> 9 ops
+#   ch          = g ^ (e & (f ^ g))             -> 3 ops
+#   maj         = b ^ ((a^b) & cached(b^c))     -> 3 ops amortized
+#   round       = Sigmas + ch + maj + 7 adds    -> 35 ops
+# and every remaining op's operands are both nonce-varying (verified by
+# operand-shape audit of the traced jaxpr), so no further fold exists.
+# The h0/h1 check reads the a-chain's LAST two values, which transitively
+# need the full state at round 61 — unlike Bitcoin's h7 (e-chain) check,
+# no whole rounds of the second compression can be elided.
+
+_VEC, _SCAL, _ZERO, _CONST = "v", "s", "z", "c"
+
+
+def _m_bin(x: str, y: str) -> tuple[str, int]:
+    """(result class, vector-op cost) of a 2-operand bitop/add."""
+    if x == _VEC or y == _VEC:
+        return _VEC, 1
+    if x == _SCAL or y == _SCAL:
+        return _SCAL, 0
+    return _CONST, 0          # const op const folds at trace time
+
+
+def _m_usum(terms: list[str]) -> tuple[str, int]:
+    """Mirror of the kernels' _usum: uniform terms first, concrete zeros
+    skipped, each vector term exactly one add."""
+    vec = [t for t in terms if t == _VEC]
+    uni = [t for t in terms if t in (_SCAL, _CONST)]
+    if not vec:
+        return (_SCAL if uni else _ZERO), 0
+    cost = len(vec) if uni else len(vec) - 1
+    return _VEC, cost
+
+
+def _m_round(state: list[str], wi: str, ab_prev: str | None,
+             last: bool = False) -> tuple[list[str], str, int]:
+    """One SHA round over uniformity classes; returns (new state,
+    new ab cache, vector ops). ``last`` elides the e-chain update (the
+    second compression's round 63 — h4..h7 are never read)."""
+    a, b, c, d, e, f, g, h = state
+    ops = 0
+    S1 = e
+    ops += 11 if e == _VEC else 0
+    fg, n = _m_bin(f, g); ops += n
+    ech, n = _m_bin(e, fg); ops += n
+    ch, n = _m_bin(g, ech); ops += n
+    t1, n = _m_usum([h, S1, ch, _CONST, wi]); ops += n
+    S0 = a
+    ops += 11 if a == _VEC else 0
+    ab, n = _m_bin(a, b); ops += n
+    bc = ab_prev if ab_prev is not None else _m_bin(b, c)[0]
+    if ab_prev is None:
+        ops += _m_bin(b, c)[1]
+    anded, n = _m_bin(ab, bc); ops += n
+    maj, n = _m_bin(b, anded); ops += n
+    t2, n = _m_usum([S0, maj]); ops += n
+    a_new, n = _m_usum([t1, t2]); ops += n
+    if last:
+        return [a_new, a, b, c, e, e, f, g], ab, ops
+    e_new, n = _m_usum([d, t1]); ops += n
+    return [a_new, a, b, c, e_new, e, f, g], ab, ops
+
+
+def _m_expand(w: list[str], r: int) -> int:
+    """Schedule expansion W[r+16] appended to w (ABSOLUTE indexing via
+    the caller's offset); returns its vector-op cost."""
+    s0 = w[r + 1]
+    s1 = w[r + 14]
+    ops = (9 if s0 == _VEC else 0) + (9 if s1 == _VEC else 0)
+    out, n = _m_usum([w[r], s0, w[r + 9], s1])
+    w.append(out)
+    return ops + n
+
+
+def kernel_op_model(difficulty_bits: int = 24) -> dict:
+    """Closed-form per-nonce ALU census of the extended-midstate kernel,
+    component by component. ``total`` equals the traced
+    ``alu_ops_per_nonce`` (experiments/roofline.py) exactly."""
+    parts: dict[str, int] = {}
+    # Nonce synthesis + byte swap: base + row*LANES + lane (mul + 2
+    # adds), then the 10-op bswap.
+    parts["nonce_gen"] = 3
+    parts["bswap"] = 10
+    # Hash 1 residue: round 3 folds to two adds; w18 = rc18 + sigma0(w3)
+    # (9 + 1), w19 = w3 + rc19 (1).
+    parts["hash1_entry"] = 2 + 10 + 1
+    # Window w4..w19: layout consts (w4, w15 nonzero; w5..w14 zero),
+    # per-template scalars w16/w17, vector w18/w19.
+    w1 = [_CONST] + [_ZERO] * 10 + [_CONST, _SCAL, _SCAL, _VEC, _VEC]
+    w1 = [None] * 4 + w1          # absolute indexing: w1[i] == class(W[i])
+    state = [_VEC, _SCAL, _SCAL, _SCAL, _VEC, _SCAL, _SCAL, _SCAL]
+    rounds = sched = 0
+    ab_prev = None
+    for r in range(4, 64):
+        state, ab_prev, n = _m_round(state, w1[r], ab_prev)
+        rounds += n
+        if r + 16 < 64:
+            sched += _m_expand(w1, r)
+    parts["hash1_rounds"] = rounds
+    parts["hash1_schedule"] = sched
+    # Feed-forward vs the original midstate: all 8 digest words feed
+    # hash 2's message.
+    parts["hash1_feedforward"] = 8
+    # Hash 2: message = 8 vector digest words + the fixed padding.
+    w2 = [_VEC] * 8 + [_CONST] + [_ZERO] * 6 + [_CONST]
+    state = [_CONST] * 8
+    rounds = sched = 0
+    ab_prev = None
+    for r in range(64):
+        state, ab_prev, n = _m_round(state, w2[r], ab_prev, last=(r == 63))
+        rounds += n
+        if r + 16 < 64:
+            sched += _m_expand(w2, r)
+    parts["hash2_rounds"] = rounds
+    parts["hash2_schedule"] = sched
+    # Feed-forward: h0 always; h1 only when the mask reads it.
+    parts["hash2_feedforward"] = 1 + (1 if difficulty_bits > 32 else 0)
+    # Difficulty mask + the bias flip for the signed min reduction
+    # (jnp.where/bitcast/convert are data movement, not ALU slots).
+    d = int(difficulty_bits)
+    parts["qualify"] = (0 if d <= 0 else 1 if d <= 32 else 3) + 1
+    return {"total": sum(parts.values()), "difficulty_bits": d,
+            "components": parts,
+            "round_alu_ops": 35, "expansion_alu_ops": 21,
+            "vector_rounds": 60 + 64}
+
+
 # ---- span-split attribution ----------------------------------------------
 
 # span name -> bucket. Unlisted spans fold into "other" (they still

@@ -5,6 +5,7 @@ regex edits, so they stay in sync with the real files forever: a fixture
 is the real capi.cpp/chain.hpp plus exactly the deliberate drift under
 test, and the assertions are on exact rule ids.
 """
+import os
 import pathlib
 import re
 import subprocess
@@ -1396,6 +1397,124 @@ def test_opbudget_cli_pass_family(tmp_path):
         cwd=ROOT, capture_output=True, text=True, timeout=600)
     assert proc.returncode == 1, proc.stdout + proc.stderr
     assert "OPB001" in proc.stdout
+
+
+# ---- OPBUDGET: host-vs-per-nonce census split (ISSUE 15) ----------------
+
+
+def test_opbudget_hoist_registers_as_decrease_not_noise(tmp_path):
+    """The satellite pin: moving an expression from the kernel entry to
+    the per-template host module LOWERS the ratcheted kernel census and
+    RAISES only the separately-tracked host census — no OPB001, no
+    moved-ops noise in the gated number."""
+    from mpi_blockchain_tpu.analysis.opbudget import (run_opbudget,
+                                                      static_alu_census)
+
+    fat_kernel = ("def _tile_result(ms, base):\n"
+                  "    pre = ms + base + ms + base\n"
+                  "    return pre + base\n")
+    thin_kernel = ("def _tile_result(ms, base):\n"
+                   "    return ms + base\n")
+    host = ("def extend_midstate(ms, tail):\n"
+            "    return ms + tail + ms + tail\n")
+    kern, hostp = tmp_path / "kernel.py", tmp_path / "host.py"
+    hostp.write_text(host)
+    kern.write_text(fat_kernel)
+    fat = static_alu_census(kern)
+    kern.write_text(thin_kernel)
+    thin = static_alu_census(kern)
+    assert thin < fat
+    assert static_alu_census(hostp, "extend_midstate") == 3
+    budget = _budget_json(tmp_path, static_alu_ops=fat,
+                          static_host_alu_ops=3)
+    notes: list = []
+    assert run_opbudget(ROOT, overrides={"opbudget_json": budget,
+                                         "kernel_src": kern,
+                                         "host_src": hostp},
+                        notes=notes) == []
+    # The decrease is reported as ratchet headroom, not hidden.
+    assert any("below the budget" in n for n in notes)
+
+
+def test_opbudget_renamed_host_entry_fires_opb003(tmp_path):
+    from mpi_blockchain_tpu.analysis.opbudget import run_opbudget
+
+    kern = tmp_path / "kernel.py"
+    kern.write_text("def _tile_result(ms, base):\n    return ms + base\n")
+    hostp = tmp_path / "host.py"
+    hostp.write_text("def renamed_extend(ms, tail):\n    return ms\n")
+    budget = _budget_json(tmp_path, static_alu_ops=10,
+                          static_host_alu_ops=3)
+    findings = run_opbudget(ROOT, overrides={"opbudget_json": budget,
+                                             "kernel_src": kern,
+                                             "host_src": hostp})
+    assert [f.rule for f in findings] == ["OPB003"]
+    assert "host" in findings[0].message
+
+
+def test_opbudget_host_census_drift_is_noted(tmp_path):
+    from mpi_blockchain_tpu.analysis.opbudget import run_opbudget
+
+    kern = tmp_path / "kernel.py"
+    kern.write_text("def _tile_result(ms, base):\n    return ms + base\n")
+    hostp = tmp_path / "host.py"
+    hostp.write_text("def extend_midstate(ms, tail):\n"
+                     "    return ms + tail + ms\n")      # census 2
+    budget = _budget_json(tmp_path, static_alu_ops=10,
+                          static_host_alu_ops=7)          # stale claim
+    notes: list = []
+    assert run_opbudget(ROOT, overrides={"opbudget_json": budget,
+                                         "kernel_src": kern,
+                                         "host_src": hostp},
+                        notes=notes) == []
+    assert any("host per-template census 2" in n for n in notes)
+
+
+def test_opbudget_live_host_census_matches_committed():
+    from mpi_blockchain_tpu.analysis.opbudget import (HOST_ENTRY, HOST_SRC,
+                                                      static_alu_census)
+
+    committed = json.loads((ROOT / "OPBUDGET.json").read_text())
+    assert committed["static_host_alu_ops"] == \
+        static_alu_census(ROOT / HOST_SRC, HOST_ENTRY)
+
+
+def test_static_census_charges_usum_call_sites(tmp_path):
+    """_usum's runtime summing loop is invisible to the AST walker, so
+    the census must charge len(args) - 1 adds at every call site — a
+    regression that threads extra terms through _usum may not hide from
+    the ratchet."""
+    from mpi_blockchain_tpu.analysis.opbudget import static_alu_census
+
+    src = tmp_path / "k.py"
+    src.write_text(
+        "def _usum(*terms):\n"
+        "    acc = None\n"
+        "    for t in terms:\n"
+        "        acc = t if acc is None else acc + t\n"
+        "    return acc\n"
+        "def _tile_result(a, b, c):\n"
+        "    return _usum(a, b, c, a)\n")
+    assert static_alu_census(src) == 3
+
+
+def test_opbudget_check_budget_cli_flags_ratchet_increase(tmp_path):
+    """`make check`'s monotonicity guard: a committed budget LOWER than
+    what the tree regenerates (i.e. the tree's census moved UP) fails
+    loudly with the per-key delta and an explicit ratchet callout."""
+    committed = json.loads((ROOT / "OPBUDGET.json").read_text())
+    committed["alu_ops_per_nonce"] -= 100
+    tampered = tmp_path / "OPBUDGET.json"
+    tampered.write_text(json.dumps(committed, indent=1, sort_keys=True)
+                        + "\n")
+    proc = subprocess.run(
+        [sys.executable, "experiments/roofline.py", "--check-budget",
+         str(tampered)],
+        cwd=ROOT, capture_output=True, text=True, timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "RATCHET INCREASE" in proc.stderr
+    assert "alu_ops_per_nonce" in proc.stderr
 
 
 # ---- finding-output determinism ----------------------------------------

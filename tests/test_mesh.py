@@ -87,8 +87,9 @@ def test_multiround_full_space_round_builds():
     # multiplier without allocating the 2^29-nonce sweep.
     import jax
     import numpy as np
-    jax.eval_shape(fn, jax.ShapeDtypeStruct((8,), np.uint32),
-                   jax.ShapeDtypeStruct((16,), np.uint32),
+
+    from mpi_blockchain_tpu.ops.sha256_sched import EXT_WORDS
+    jax.eval_shape(fn, jax.ShapeDtypeStruct((EXT_WORDS,), np.uint32),
                    jax.ShapeDtypeStruct((), np.uint32),
                    jax.ShapeDtypeStruct((), np.uint32))
 

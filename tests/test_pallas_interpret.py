@@ -31,6 +31,7 @@ from conftest import needs_devices
 
 from mpi_blockchain_tpu import core
 from mpi_blockchain_tpu.ops import sha256_pallas as sp
+from mpi_blockchain_tpu.ops import sha256_sched as sched
 from mpi_blockchain_tpu.parallel.mesh import shard_map
 
 # ---- half 1: production tile math, eagerly, vs the C++ oracle -------------
@@ -38,9 +39,9 @@ from mpi_blockchain_tpu.parallel.mesh import shard_map
 
 def _eager_tile(hdr: bytes, difficulty_bits: int):
     midstate, tail = core.header_midstate(hdr)
+    ext = sched.extend_midstate(midstate, tail)
     with jax.disable_jit():
-        c, m = sp._tile_result(jnp.asarray(midstate), jnp.asarray(tail),
-                               jnp.uint32(0),
+        c, m = sp._tile_result(jnp.asarray(ext), jnp.uint32(0),
                                difficulty_bits=difficulty_bits)
     mn = int(jax.lax.bitcast_convert_type(m, jnp.uint32)
              ^ np.uint32(0x80000000))
@@ -68,20 +69,33 @@ def test_tile_result_not_found_sentinel():
 # ---- half 2: kernel program logic in interpret mode with a mock tile ------
 #
 # Contract mirror of _tile_result: "qualifying" nonces are the multiples of
-# tail_ref[0] (read from SMEM — proves the scalar prefetch plumbing), count
-# is the tile's qualifier total, min is bias-flipped like production.
+# ext_ref[EXT_W16] (read from SMEM — proves the scalar prefetch plumbing),
+# count is the tile's qualifier total, min is bias-flipped like production.
+# The tests below build the payload through the real extend_midstate with a
+# zero midstate and tail[0] = q, for which w16 = w0 + s0(0) = q exactly —
+# so the q the test plants rides the production extension path into SMEM.
 
-def _mock_tile(midstate_ref, tail_ref, base, *, difficulty_bits):
-    del midstate_ref, difficulty_bits
+def _mock_tile(ext_ref, base, *, difficulty_bits):
+    del difficulty_bits
     row = jax.lax.broadcasted_iota(jnp.uint32, (sp._ROWS, sp._LANES), 0)
     lane = jax.lax.broadcasted_iota(jnp.uint32, (sp._ROWS, sp._LANES), 1)
     nonces = base + row * np.uint32(sp._LANES) + lane
-    qual = nonces % tail_ref[0] == 0
+    qual = nonces % ext_ref[sched.EXT_W16] == 0
     count = jnp.sum(qual.astype(jnp.int32))
     biased = jax.lax.bitcast_convert_type(
         jnp.where(qual, nonces, np.uint32(0xFFFFFFFF))
         ^ np.uint32(0x80000000), jnp.int32)
     return count, jnp.min(biased)
+
+
+def test_mock_payload_carries_q_at_w16():
+    # The mock contract above leans on w16 == q for a zero midstate;
+    # pin that property of the real extension so a layout change here
+    # fails THIS line instead of scrambling every mock test below.
+    tail = np.zeros(16, np.uint32)
+    tail[0] = 5000
+    ext = sched.extend_midstate(np.zeros(8, np.uint32), tail)
+    assert int(ext[sched.EXT_W16]) == 5000
 
 
 def _mock_sweep(monkeypatch, base: int, n_tiles: int, q: int,
@@ -236,17 +250,18 @@ def test_multiround_searcher_with_interpret_pallas_on_8_mesh(
     batch = n_tiles * sp.TILE
     round_size = batch * n_miners                 # 16 tiles per round
     q = q_tiles * sp.TILE
-    sweep = functools.partial(sp.pallas_sweep_core, batch_size=batch,
+    sweep = functools.partial(sp.pallas_sweep_core_ext, batch_size=batch,
                               difficulty_bits=8, interpret=True)
     run = make_round_search(sweep, batch, round_size)
     fn = jax.jit(shard_map(
         functools.partial(run, axis_name="miners"),
-        mesh=make_miner_mesh(n_miners), in_specs=(P(),) * 4,
+        mesh=make_miner_mesh(n_miners), in_specs=(P(),) * 3,
         out_specs=(P(),) * 3, check_vma=False))   # interpret-mode-only
     tail = np.zeros(16, np.uint32)
     tail[0] = q
+    ext = sched.extend_midstate(np.zeros(8, np.uint32), tail)
     rounds, count, mn = (int(v) for v in fn(
-        np.zeros(8, np.uint32), tail, np.uint32(1), np.uint32(4)))
+        ext, np.uint32(1), np.uint32(4)))
     # Expected: first round whose contiguous range holds a multiple of q.
     exp_c, exp_m = _expected(1 + (exp_rounds - 1) * round_size,
                              round_size, q)

@@ -422,6 +422,37 @@ def test_utilization_matches_recorded_roofline():
     assert u["v5e_clock_ghz"] == 1.503
 
 
+def test_kernel_op_model_matches_committed_census():
+    """The stdlib closed-form model of the extended-midstate kernel must
+    equal the committed traced census EXACTLY — the number on the
+    roofline stays explainable from first principles (and a kernel edit
+    that moves the trace without a matching re-derivation is caught by
+    roofline.py --write-budget, which cross-checks the two)."""
+    import json
+    import pathlib
+
+    from mpi_blockchain_tpu.perfwatch.attribution import kernel_op_model
+
+    root = pathlib.Path(__file__).resolve().parent.parent
+    committed = json.loads((root / "OPBUDGET.json").read_text())
+    model = kernel_op_model(committed["difficulty_bits"])
+    assert model["total"] == committed["alu_ops_per_nonce"]
+    assert model["components"] == committed["model_components"]
+    # Sanity on the algebra the docstring derives: 35-op rounds and
+    # 21-op expansions bound the component sums.
+    assert model["components"]["hash2_rounds"] <= 63 * 35
+    assert model["components"]["hash1_rounds"] <= 60 * 35
+
+
+def test_committed_census_loader():
+    from mpi_blockchain_tpu.perfwatch.attribution import committed_census
+
+    budget = committed_census()
+    assert isinstance(budget, dict)
+    assert budget["alu_ops_per_nonce"] > 4000
+    assert committed_census("/nonexistent/dir") is None
+
+
 def test_attribute_spans_buckets_and_dominant():
     reg = telemetry.default_registry()
     from mpi_blockchain_tpu.telemetry.spans import Span
@@ -468,7 +499,14 @@ def test_cli_check_exits_nonzero_on_injected_drop(tmp_path):
     _seed(HistoryStore(clean), 970e6, 967e6)
     proc = _cli(["check", "--history", str(clean), "--json"])
     assert proc.returncode == 0, proc.stdout + proc.stderr
-    assert json.loads(proc.stdout)["regressions"] == 0
+    doc = json.loads(proc.stdout)
+    assert doc["regressions"] == 0
+    # Utilization is reported against the COMMITTED census (post-cut
+    # roofline), not whatever was live when the entry was recorded.
+    from mpi_blockchain_tpu.perfwatch.attribution import committed_census
+    assert doc["roofline"]["alu_ops_per_nonce"] == \
+        committed_census()["alu_ops_per_nonce"]
+    assert doc["roofline"]["measured_mhs"] == 967.0
 
 
 def test_cli_record_seed_then_check_real_history(tmp_path):

@@ -354,19 +354,30 @@ def test_checkpoint_seam_overlaps_next_sweep():
     """The point of the whole refactor: host work in on_block runs
     while the next block's dispatch is in flight — the pipeline report
     must see overlapped host time, and the sequential oracle must
-    not."""
-    overlaps = {}
-    for pipeline in (False, True):
-        reset_profiler()
+    not. Difficulty 15 (the pipeline-smoke's own operating point) keeps
+    the device window long enough that the fraction sits at ~0.6 —
+    difficulty 13 measured ~0.30 on this box, right ON the bound, and
+    lost to host weather in full-suite runs; best-of-<=3 on top (the
+    repo's timing-smoke discipline)."""
+    for attempt in range(3):
+        overlaps = {}
+        for pipeline in (False, True):
+            reset_profiler()
 
-        def on_block(rec):
-            with profiler().segment_on_last("checkpoint"):
-                time.sleep(0.01)     # stand-in for the checkpoint write
+            def on_block(rec):
+                with profiler().segment_on_last("checkpoint"):
+                    time.sleep(0.01)     # stand-in for the checkpoint write
 
-        cfg = MinerConfig(difficulty_bits=13, n_blocks=4, backend="cpu",
-                          data_prefix="sweep")
-        _quiet(cfg, pipeline=pipeline).mine_chain(on_block=on_block)
-        overlaps[pipeline] = pipeline_report()
+            cfg = MinerConfig(difficulty_bits=15, n_blocks=4, backend="cpu",
+                              data_prefix="sweep")
+            _quiet(cfg, pipeline=pipeline).mine_chain(on_block=on_block)
+            overlaps[pipeline] = pipeline_report()
+        if attempt < 2 and not (
+                overlaps[True]["host_overlapped_fraction"] > 0.3
+                and overlaps[True]["bubble_fraction"]
+                < overlaps[False]["bubble_fraction"]):
+            continue
+        break
     assert overlaps[True]["host_overlapped_fraction"] > 0.3
     assert overlaps[True]["bubble_fraction"] < \
         overlaps[False]["bubble_fraction"]
