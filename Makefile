@@ -4,23 +4,27 @@
 PY ?= python3
 SHELL := /bin/bash   # tier1 uses pipefail/PIPESTATUS
 
-.PHONY: check lint lint-fast opbudget-check metrics-smoke forensics-smoke \
+.PHONY: check lint lint-fast opbudget-check shardbudget-check \
+        metrics-smoke forensics-smoke \
         perf-smoke chaos-smoke adversary-smoke meshwatch-smoke \
         elastic-smoke trace-smoke pipeline-smoke tier1 core clean
 
-check: lint opbudget-check metrics-smoke forensics-smoke perf-smoke \
+check: lint opbudget-check shardbudget-check metrics-smoke \
+        forensics-smoke perf-smoke \
         chaos-smoke adversary-smoke meshwatch-smoke elastic-smoke \
         trace-smoke pipeline-smoke tier1
 
 # chainlint: binding contract, header layout, JAX purity, sanitizer
 # matrix, thread races (CONC), SPMD collectives, hot-path blocking,
 # device-sync provenance (SYNC), buffer donation (DON), deadlint
-# (LCK lock-order, FUT future lifecycle, THR thread lifecycle), and
-# the three committed ratchets: OPBUDGET.json (kernel ALU ops),
-# TRANSFERBUDGET.json (sweep-path host<->device transfer sites), and
-# WAITBUDGET.json (sweep-scope blocking-wait sites) — so `make check`
-# gates on all three budgets. --audit-suppressions rides the same run
-# and is warning-only: it prints rot but never fails the gate.
+# (LCK lock-order, FUT future lifecycle, THR thread lifecycle),
+# shardlint (SHD partition-spec/axis-context), and the four committed
+# ratchets: OPBUDGET.json (kernel ALU ops), TRANSFERBUDGET.json
+# (sweep-path host<->device transfer sites), WAITBUDGET.json
+# (sweep-scope blocking-wait sites), and SHARDBUDGET.json (SPMD-scope
+# collective call sites) — so `make check` gates on all four budgets.
+# --audit-suppressions rides the same run and is warning-only: it
+# prints rot but never fails the gate.
 lint:
 	$(PY) -m mpi_blockchain_tpu.analysis --jobs 4 --audit-suppressions
 
@@ -35,6 +39,14 @@ lint-fast:
 # (the ratchet only goes down; docs/perfwatch.md §Roofline).
 opbudget-check:
 	env JAX_PLATFORMS=cpu $(PY) experiments/roofline.py --check-budget
+
+# SHARDBUDGET monotonicity guard: re-running the sanctioned mover's
+# census (static collective sites + the traced per-flavor collective
+# census of the mesh sweep) must reproduce the committed
+# SHARDBUDGET.json byte-for-byte; growth fails loudly as a RATCHET
+# INCREASE with the delta (docs/static_analysis.md §SBD).
+shardbudget-check:
+	env JAX_PLATFORMS=cpu $(PY) -m mpi_blockchain_tpu.analysis.shard_budget --check
 
 # Telemetry smoke: the instrumented mini-run (mine + faulted sim) must
 # exit 0 and emit a Prometheus snapshot with the headline counters.

@@ -57,6 +57,16 @@ classic *drift* bugs at analysis time, before any run launches:
   non-daemon threads nobody joins, thread-side unlocked writes racing
   host-side reads (THR0xx rules), and the static blocking-wait census
   pinned in the committed ``WAITBUDGET.json`` (TBW0xx rules).
+* ``shard_lint`` — partition-spec & axis-context discipline on the
+  mesh code: shard_map in/out_specs arity drift, collectives reachable
+  without an enclosing axis context, rank-divergent values flowing
+  into traced shapes/trip counts, and raw shard_map imports outside
+  the sanctioned compat seam (SHD0xx rules).
+* ``shard_budget`` — the collective-site ratchet: the SPMD scope's
+  static collective call-site census must not exceed the committed
+  ``SHARDBUDGET.json``, whose traced section pins exactly which
+  collective primitives each mesh sweep dispatch carries (SBD0xx
+  rules).
 
 CLI: ``python -m mpi_blockchain_tpu.analysis`` — exits non-zero on any
 finding. Findings are emitted in a deterministic (file, line, rule)
@@ -244,6 +254,8 @@ def pass_families() -> dict[str, Callable[..., list[Finding]]]:
     from .opbudget import run_opbudget
     from .resilience_lint import run_resilience_lint
     from .sanitizers import run_sanitizers
+    from .shard_budget import run_shard_budget
+    from .shard_lint import run_shard_lint
     from .spmd_lint import run_spmd_lint
     from .sync_lint import run_sync_lint
     from .telemetry_lint import run_telemetry_lint
@@ -266,6 +278,8 @@ def pass_families() -> dict[str, Callable[..., list[Finding]]]:
         "thread": run_thread_lint,
         "opbudget": run_opbudget,
         "trb": run_transfer_budget,
+        "shard": run_shard_lint,
+        "sbd": run_shard_budget,
     }
 
 
@@ -305,6 +319,10 @@ FAMILY_SCOPES: dict[str, tuple[str, ...]] = {
             "mpi_blockchain_tpu/parallel",
             "mpi_blockchain_tpu/resilience/dispatch.py",
             "TRANSFERBUDGET.json"),
+    "shard": ("mpi_blockchain_tpu/parallel", "mpi_blockchain_tpu/backend",
+              "mpi_blockchain_tpu/models", "experiments"),
+    "sbd": ("mpi_blockchain_tpu/parallel", "mpi_blockchain_tpu/backend",
+            "mpi_blockchain_tpu/models", "SHARDBUDGET.json"),
 }
 
 #: Rule-id prefix -> owning family (suppression audit attribution).
@@ -313,7 +331,8 @@ RULE_FAMILIES = {"BIND": "binding", "HDR": "header", "JAX": "jax",
                  "RES": "resilience", "CONC": "conc", "SPMD": "spmd",
                  "HOT": "hotpath", "SYNC": "sync", "DON": "don",
                  "LCK": "lock", "FUT": "future", "THR": "thread",
-                 "TBW": "thread", "OPB": "opbudget", "TRB": "trb"}
+                 "TBW": "thread", "OPB": "opbudget", "TRB": "trb",
+                 "SHD": "shard", "SBD": "sbd"}
 
 
 #: A change under the analysis engine itself (a pass module, the

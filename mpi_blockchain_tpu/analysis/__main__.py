@@ -21,6 +21,10 @@ Modes beyond the default lint run:
 * ``--rebaseline-waits`` — the same ratchet for the blocking-wait
   census into WAITBUDGET.json (mover: ``python -m
   mpi_blockchain_tpu.analysis.thread_lint --write``).
+* ``--rebaseline-shards`` — the same ratchet for the collective-site
+  census into SHARDBUDGET.json (mover: ``python -m
+  mpi_blockchain_tpu.analysis.shard_budget --write``, which also
+  re-traces the per-flavor collective census).
 * ``--jobs N`` — run pass families on a thread pool; per-pass wall
   times are always collected and emitted under ``pass_timings_ms`` in
   ``--json`` output (which is a JSON object: ``{"findings": [...],
@@ -49,7 +53,8 @@ OVERRIDE_KEYS = ("capi", "ctypes_binding", "pybind", "chain_hpp",
                  "sync_files", "donation_files",
                  "transferbudget_json", "transfer_files",
                  "lock_files", "future_files", "thread_files",
-                 "wait_files", "waitbudget_json")
+                 "wait_files", "waitbudget_json",
+                 "shard_files", "shardbudget_json")
 
 
 def _changed_files(root: pathlib.Path, rev: str) -> list[str] | None:
@@ -83,8 +88,9 @@ def main(argv: list[str] | None = None) -> int:
                     "sanitizer matrix, thread races, SPMD collectives, "
                     "hot-path blocking, device-sync provenance, "
                     "buffer donation, deadlint lock-order/future/"
-                    "thread lifecycle, op-budget + transfer-budget + "
-                    "wait-budget ratchets)")
+                    "thread lifecycle, shardlint partition-spec/axis-"
+                    "context, op-budget + transfer-budget + wait-budget "
+                    "+ collective-site ratchets)")
     parser.add_argument("--root", type=pathlib.Path, default=None,
                         help="repo root (default: auto-detected)")
     parser.add_argument("--passes", default=None,
@@ -118,6 +124,10 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--rebaseline-waits", action="store_true",
                         help="write the current static blocking-wait "
                              "census into WAITBUDGET.json (refuses to "
+                             "raise it)")
+    parser.add_argument("--rebaseline-shards", action="store_true",
+                        help="write the current static collective-site "
+                             "census into SHARDBUDGET.json (refuses to "
                              "raise it)")
     parser.add_argument("-q", "--quiet", action="store_true",
                         help="suppress the summary/notes lines")
@@ -165,6 +175,18 @@ def main(argv: list[str] | None = None) -> int:
                   file=sys.stderr)
             return 2
         print(f"chainlint: wait budget rebaselined {old} -> {new} "
+              f"({path})", file=sys.stderr)
+        return 0
+
+    if args.rebaseline_shards:
+        from .shard_budget import rebaseline_shards
+        try:
+            old, new, path = rebaseline_shards(root, overrides)
+        except (ValueError, OSError) as e:
+            print(f"chainlint: rebaseline-shards refused: {e}",
+                  file=sys.stderr)
+            return 2
+        print(f"chainlint: collective budget rebaselined {old} -> {new} "
               f"({path})", file=sys.stderr)
         return 0
 

@@ -51,12 +51,14 @@ the drift-fixture seams.
 from __future__ import annotations
 
 import ast
-import json
 import pathlib
 
 from . import Finding, rel_path
+from .budget import (int_key_error, read_json_object, refuse_upward,
+                     require_amendable, write_json_budget)
 
 BASELINE_NAME = "OPBUDGET.json"
+MOVER = "python experiments/roofline.py --write-budget"
 KERNEL_SRC = "mpi_blockchain_tpu/ops/sha256_pallas.py"
 CENSUS_ENTRY = "_tile_result"
 HOST_SRC = "mpi_blockchain_tpu/ops/sha256_sched.py"
@@ -290,20 +292,14 @@ def _rel(path: pathlib.Path, root: pathlib.Path) -> str:
 
 def load_baseline(baseline: pathlib.Path) -> tuple[dict | None, str]:
     """(budget dict, error message) — dict None iff invalid."""
-    try:
-        data = json.loads(baseline.read_text())
-    except OSError as e:
-        return None, f"cannot read {baseline.name}: {e}"
-    except ValueError as e:
-        return None, f"{baseline.name} is not valid JSON: {e}"
-    if not isinstance(data, dict):
-        return None, f"{baseline.name} must hold a JSON object"
+    data, err = read_json_object(baseline)
+    if data is None:
+        return None, err
     for key in REQUIRED_KEYS:
-        if not isinstance(data.get(key), int) or data[key] <= 0:
-            return None, (f"{baseline.name} lacks a positive integer "
-                          f"{key!r} — regenerate it with "
-                          f"`python experiments/roofline.py "
-                          f"--write-budget`")
+        err = int_key_error(data, baseline.name, key, MOVER,
+                            positive=True)
+        if err:
+            return None, err
     return data, ""
 
 
@@ -395,18 +391,11 @@ def rebaseline(root: pathlib.Path,
         raise ValueError(f"census entry '{CENSUS_ENTRY}' not found in "
                          f"{src} — nothing to baseline")
     old_data, err = load_baseline(baseline_path)
-    if old_data is None:
-        raise ValueError(
-            f"no valid baseline to amend ({err}); bootstrap the budget "
-            f"with `python experiments/roofline.py --write-budget`")
+    old_data = require_amendable(old_data, err, MOVER)
     old = old_data["static_alu_ops"]
-    if current > old:
-        raise ValueError(
-            f"refusing to rebaseline upward: static census {current} > "
-            f"committed budget {old}. The op budget only ratchets down; "
-            f"a justified increase must go through "
-            f"`python experiments/roofline.py --write-budget` and a "
-            f"reviewed OPBUDGET.json diff")
+    refuse_upward(current, old, census_label="static census",
+                  policy="The op budget only ratchets down",
+                  mover=MOVER, baseline_name=BASELINE_NAME)
     data = dict(old_data)
     data["static_alu_ops"] = current
     if isinstance(old_data.get("static_host_alu_ops"), int):
@@ -415,6 +404,5 @@ def rebaseline(root: pathlib.Path,
             data["static_host_alu_ops"] = host_cost
     data.setdefault("source", KERNEL_SRC)
     data.setdefault("census_entry", CENSUS_ENTRY)
-    baseline_path.write_text(json.dumps(data, indent=1, sort_keys=True)
-                             + "\n")
+    write_json_budget(baseline_path, data)
     return old, current, baseline_path

@@ -1,0 +1,418 @@
+"""SBD rules — the collective-site budget ratchet for the mesh sweep.
+
+The fourth committed ratchet in the OPBUDGET / TRANSFERBUDGET /
+WAITBUDGET lineage, and the one that gates the v5e-8 bring-up
+(ROADMAP item 1): accelerator-parallel consensus lives or dies on
+exactly two collectives per round — ``winner_select``'s psum + pmin
+(parallel/mesh.py) — and nothing stopped a refactor from silently
+adding a host gather or an extra rendezvous to the hot path. This pass
+is the tripwire: ``SHARDBUDGET.json`` pins a **static collective-site
+census** — a deterministic count of collective call sites
+(``psum``/``pmin``/``all_gather``/``axis_index``/... plus calls to the
+sanctioned ``winner_select`` seam itself) over the SPMD-scope sources —
+and the build fails when the census grows.
+
+Like its siblings the static census is a monotone *proxy*; the
+physically-meaningful numbers ride along in the baseline's ``traced``
+section: the one sanctioned mover —
+``python -m mpi_blockchain_tpu.analysis.shard_budget --write``
+(imports jax lazily; this gate pass never does) — builds a 1-device
+('miners',) mesh, traces ``make_mesh_sweep_fn`` per traceable kernel
+flavor and pins exactly which collective primitives appear per sweep
+dispatch (today: one psum + one pmin, axes ``('miners',)``, 8
+replicated payload bytes), so the committed diff names every
+collective the ICI carries per round.
+
+  SBD001  the static collective-site census exceeds the committed
+          budget — a RATCHET INCREASE: collective sites on the sweep
+          path only ratchet DOWN. A justified increase goes through
+          the sanctioned mover and a reviewed SHARDBUDGET.json diff;
+          ``--rebaseline-shards`` only accepts a LOWER census.
+  SBD002  SHARDBUDGET.json is missing, unparseable, or lacks the
+          required keys — the collective ratchet is not armed.
+  SBD003  the census scope resolves to no readable source file — the
+          gate is counting nothing (update ``SHARD_SCOPE`` here
+          alongside a sweep-path refactor).
+
+``--check`` (the ``make shardbudget-check`` target) re-runs the FULL
+mover census — static and traced — and fails unless the committed
+baseline reproduces byte-identically, calling out any growth as a
+RATCHET INCREASE with the delta.
+
+Override keys: ``shardbudget_json`` (baseline path), ``shard_files``
+(census file set, shared with the SHD pass) — the drift-fixture seams.
+"""
+from __future__ import annotations
+
+import ast
+import pathlib
+
+from . import Finding, override_files, rel_path, source_cached
+from .budget import (int_key_error, read_json_object, refuse_upward,
+                     require_amendable, write_json_budget)
+from .callgraph import call_name
+from .shard_lint import _is_collective
+
+BASELINE_NAME = "SHARDBUDGET.json"
+REQUIRED_KEYS = ("static_collective_sites", "traced")
+MOVER = "python -m mpi_blockchain_tpu.analysis.shard_budget --write"
+
+#: The SPMD-scope sources whose collective call sites are budgeted —
+#: everything between the mine loop and the mesh program.
+SHARD_SCOPE = (
+    "mpi_blockchain_tpu/parallel/mesh.py",
+    "mpi_blockchain_tpu/parallel/distributed.py",
+    "mpi_blockchain_tpu/backend/tpu.py",
+    "mpi_blockchain_tpu/models/fused.py",
+    "mpi_blockchain_tpu/models/miner.py",
+)
+
+#: Calls to the winner-select seam count as collective sites: adding a
+#: seam call site IS adding a per-round collective pair, and must show
+#: up in a reviewed baseline diff.
+_SEAM_CALLS = {"winner_select"}
+
+#: Communicating collective primitives in a traced jaxpr (axis queries
+#: like axis_index are censused but carry no payload). Version-suffixed
+#: spellings normalize to the base name.
+_COMM_PRIMS = {"psum", "pmin", "pmax", "pmean", "all_gather",
+               "all_to_all", "ppermute"}
+_PRIM_ALIASES = {"psum2": "psum", "psum_invariant": "psum"}
+
+
+def static_collective_census(
+        root: pathlib.Path, files: list[pathlib.Path]
+) -> tuple[int, dict[str, int], list[dict],
+           list[tuple[str, int, str]]]:
+    """(total, per-label counts, per-site records, syntax errors) over
+    the scoped files — collective/axis-query calls plus winner_select
+    seam calls (labels are the rightmost call name)."""
+    total = 0
+    by_label: dict[str, int] = {}
+    sites: list[dict] = []
+    errors: list[tuple[str, int, str]] = []
+    for path in sorted(pathlib.Path(p) for p in files):
+        rel = rel_path(path, root)
+        try:
+            _, tree, err = source_cached(path)
+        except OSError:
+            continue
+        if tree is None:
+            errors.append((rel, err[0], err[1]))
+            continue
+        found: list[tuple[int, str]] = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            if _is_collective(node) or name in _SEAM_CALLS:
+                found.append((node.lineno, name))
+        for lineno, label in sorted(found):
+            total += 1
+            by_label[label] = by_label.get(label, 0) + 1
+            sites.append({"file": rel, "line": lineno, "label": label})
+    return total, by_label, sites, errors
+
+
+def _paths(root: pathlib.Path, overrides: dict
+           ) -> tuple[pathlib.Path, list[pathlib.Path]]:
+    baseline = pathlib.Path(overrides.get("shardbudget_json",
+                                          root / BASELINE_NAME))
+    files = override_files(overrides, "shard_files",
+                           lambda: [root / p for p in SHARD_SCOPE])
+    return baseline, files
+
+
+def load_baseline(baseline: pathlib.Path) -> tuple[dict | None, str]:
+    """(budget dict, error message) — dict None iff invalid."""
+    data, err = read_json_object(baseline)
+    if data is None:
+        return None, err
+    err = int_key_error(data, baseline.name, "static_collective_sites",
+                        MOVER)
+    if err:
+        return None, err
+    if not isinstance(data.get("traced"), dict):
+        return None, (f"{baseline.name} lacks the 'traced' per-flavor "
+                      f"collective census — regenerate it with "
+                      f"`{MOVER}`")
+    return data, ""
+
+
+def run_shard_budget(root: pathlib.Path, overrides=None,
+                     notes=None) -> list[Finding]:
+    overrides = overrides or {}
+    baseline_path, files = _paths(root, overrides)
+    baseline, err = load_baseline(baseline_path)
+    if baseline is None:
+        return [Finding(rel_path(baseline_path, root), 1, "SBD002",
+                        f"collective-site ratchet is not armed: {err}")]
+    readable = [p for p in files if pathlib.Path(p).is_file()]
+    if not readable:
+        return [Finding("mpi_blockchain_tpu", 1, "SBD003",
+                        "collective-site census scope resolves to no "
+                        "readable source file — the gate is counting "
+                        "nothing; update SHARD_SCOPE in "
+                        "analysis/shard_budget.py alongside the "
+                        "refactor")]
+    total, by_label, sites, errors = static_collective_census(
+        root, readable)
+    findings = [Finding(rel, lineno, "SBD000", f"syntax error: {msg}")
+                for rel, lineno, msg in errors]
+    budget = baseline["static_collective_sites"]
+    if total > budget:
+        anchor = (sites[0]["file"], sites[0]["line"]) if sites else (
+            rel_path(pathlib.Path(readable[0]), root), 1)
+        breakdown = ", ".join(f"{k}×{v}"
+                              for k, v in sorted(by_label.items()))
+        findings.append(Finding(
+            anchor[0], anchor[1], "SBD001",
+            f"RATCHET INCREASE: static collective-site census grew: "
+            f"{total} > budget {budget} (delta +{total - budget}; "
+            f"{breakdown}). The sweep path carries exactly the "
+            f"collectives SHARDBUDGET.json pins — an accidental host "
+            f"gather or extra rendezvous here is a multi-chip "
+            f"regression (ROADMAP item 1's v5e-8 bring-up depends on "
+            f"it); if this increase is justified, re-census with "
+            f"`{MOVER}` and commit the SHARDBUDGET.json diff"))
+    elif total < budget and notes is not None:
+        notes.append(f"shard_budget: static census {total} is below "
+                     f"the budget {budget} — ratchet it down with "
+                     f"--rebaseline-shards (or the --write mover)")
+    return findings
+
+
+def rebaseline_shards(root: pathlib.Path,
+                      overrides=None) -> tuple[int, int, pathlib.Path]:
+    """Writes the current static collective census into the baseline,
+    refusing to RAISE it (the ratchet). Returns (old, new, path).
+    Raises ValueError when the census is higher, the scope is empty, or
+    there is no valid baseline to amend — bootstrapping (and any
+    justified raise) is the sanctioned mover's job (``shard_budget
+    --write``, which records the traced per-flavor census too)."""
+    overrides = overrides or {}
+    baseline_path, files = _paths(root, overrides)
+    readable = [p for p in files if pathlib.Path(p).is_file()]
+    if not readable:
+        raise ValueError("collective census scope resolves to no "
+                         "readable source file — nothing to baseline")
+    total, by_label, sites, errors = static_collective_census(
+        root, readable)
+    if errors:
+        raise ValueError(f"census scope has syntax errors: {errors[0]}")
+    old_data, err = load_baseline(baseline_path)
+    old_data = require_amendable(old_data, err, MOVER)
+    old = old_data["static_collective_sites"]
+    refuse_upward(total, old, census_label="static collective census",
+                  policy="Collective sites only ratchet down",
+                  mover=MOVER, baseline_name=BASELINE_NAME)
+    data = dict(old_data)
+    data["static_collective_sites"] = total
+    data["static_by_site"] = dict(sorted(by_label.items()))
+    data["sites"] = sites
+    data["scope"] = [rel_path(pathlib.Path(p), root) for p in readable]
+    write_json_budget(baseline_path, data)
+    return old, total, baseline_path
+
+
+# ---- the sanctioned mover (imports jax; never run by the gate) -------------
+
+
+def _census_jaxpr(jaxpr, counts: dict[str, int], axes: set,
+                  payload: list[int]) -> None:
+    """Recursive collective-primitive census over a jaxpr: counts per
+    normalized primitive name, axis names bound, and the replicated
+    payload bytes the communicating collectives move."""
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        name = _PRIM_ALIASES.get(name, name)
+        if name in _COMM_PRIMS or name in ("axis_index", "axis_size"):
+            counts[name] = counts.get(name, 0) + 1
+            for key in ("axes", "axis_name"):
+                v = eqn.params.get(key)
+                if isinstance(v, (tuple, list)):
+                    axes.update(str(a) for a in v)
+                elif isinstance(v, str):
+                    axes.add(v)
+            if name in _COMM_PRIMS:
+                for var in eqn.outvars:
+                    aval = getattr(var, "aval", None)
+                    if aval is not None and hasattr(aval, "dtype"):
+                        size = 1
+                        for d in getattr(aval, "shape", ()):
+                            size *= int(d)
+                        payload.append(size * aval.dtype.itemsize)
+        for v in eqn.params.values():
+            for sub in (v if isinstance(v, (list, tuple)) else (v,)):
+                inner = getattr(sub, "jaxpr", None)
+                if inner is not None and hasattr(inner, "eqns"):
+                    _census_jaxpr(inner, counts, axes, payload)
+                elif hasattr(sub, "eqns"):
+                    _census_jaxpr(sub, counts, axes, payload)
+
+
+def trace_collective_census() -> dict[str, dict]:
+    """Traces ``make_mesh_sweep_fn`` per traceable kernel flavor over a
+    1-device ('miners',) mesh (always available, deterministic — the
+    collective census is device-count independent) and censuses the
+    collective primitives per sweep dispatch. Flavors whose kernel
+    cannot build on this platform (pallas off-TPU raises ConfigError)
+    are recorded under ``skipped`` by exception class, so a CPU mover
+    run stays reproducible."""
+    import jax  # noqa: F401  (the mover contract: jax only here)
+    import numpy as np
+
+    from ..config import ConfigError
+    from ..parallel.mesh import make_miner_mesh, make_mesh_sweep_fn
+
+    mesh = make_miner_mesh(1)
+    u32 = np.uint32
+    flavors: dict[str, dict] = {}
+    skipped: dict[str, str] = {}
+    for flavor in ("jnp", "pallas"):
+        try:
+            fn = make_mesh_sweep_fn(mesh, batch_size=1 << 8,
+                                    difficulty_bits=12, kernel=flavor)
+            closed = jax.make_jaxpr(fn)(
+                np.zeros(8, u32), np.zeros(16, u32), u32(0))
+        except ConfigError as e:
+            skipped[flavor] = type(e).__name__
+            continue
+        counts: dict[str, int] = {}
+        axes: set = set()
+        payload: list[int] = []
+        _census_jaxpr(closed.jaxpr, counts, axes, payload)
+        flavors[flavor] = {
+            "primitives": dict(sorted(counts.items())),
+            "collective_total": sum(v for k, v in counts.items()
+                                    if k in _COMM_PRIMS),
+            "axis_names": sorted(axes),
+            "replicated_payload_bytes": sum(payload),
+        }
+    out: dict[str, dict] = dict(sorted(flavors.items()))
+    if skipped:
+        out["skipped"] = dict(sorted(skipped.items()))
+    return out
+
+
+def _full_census(root: pathlib.Path, overrides=None) -> dict:
+    baseline_path, files = _paths(root, overrides or {})
+    readable = [p for p in files if pathlib.Path(p).is_file()]
+    total, by_label, sites, errors = static_collective_census(
+        root, readable)
+    if errors:
+        raise ValueError(f"census scope has syntax errors: {errors[0]}")
+    return {
+        "static_collective_sites": total,
+        "static_by_site": dict(sorted(by_label.items())),
+        "sites": sites,
+        "scope": [rel_path(pathlib.Path(p), root) for p in readable],
+        "traced": trace_collective_census(),
+        "writer": MOVER,
+    }
+
+
+def write_budget(root: pathlib.Path | None = None,
+                 overrides=None) -> pathlib.Path:
+    """The one sanctioned mover: full rewrite of SHARDBUDGET.json —
+    static census plus the traced per-flavor collective census (the
+    committed diff is the review surface)."""
+    from . import default_root
+
+    root = root if root is not None else default_root()
+    baseline_path, _ = _paths(root, overrides or {})
+    write_json_budget(baseline_path, _full_census(root, overrides))
+    return baseline_path
+
+
+def check_budget(root: pathlib.Path | None = None,
+                 overrides=None) -> int:
+    """The ``make shardbudget-check`` gate: recomputes the full mover
+    census and requires the committed baseline to reproduce it
+    byte-identically. Growth is a RATCHET INCREASE (rc 1 with the
+    delta); any other drift is staleness (rc 1); an unarmed baseline
+    is rc 2."""
+    import sys
+
+    from . import default_root
+
+    root = root if root is not None else default_root()
+    baseline_path, _ = _paths(root, overrides or {})
+    committed, err = load_baseline(baseline_path)
+    if committed is None:
+        print(f"shard_budget: not armed: {err}", file=sys.stderr)
+        return 2
+    current = _full_census(root, overrides)
+    cur, old = (current["static_collective_sites"],
+                committed["static_collective_sites"])
+    if cur > old:
+        print(f"shard_budget: RATCHET INCREASE: static collective "
+              f"census {cur} > committed {old} (delta +{cur - old}) — "
+              f"collective sites on the sweep path only ratchet down; "
+              f"a justified increase goes through `{MOVER}` and a "
+              f"reviewed {BASELINE_NAME} diff", file=sys.stderr)
+        return 1
+    for flavor, traced in current["traced"].items():
+        if flavor == "skipped":
+            continue
+        was = committed["traced"].get(flavor, {})
+        t_cur = traced.get("collective_total", 0)
+        t_old = was.get("collective_total", 0)
+        if t_cur > t_old:
+            print(f"shard_budget: RATCHET INCREASE: traced collective "
+                  f"census for flavor '{flavor}' {t_cur} > committed "
+                  f"{t_old} (delta +{t_cur - t_old}) — the sweep "
+                  f"dispatch grew a collective; re-census with "
+                  f"`{MOVER}` if justified", file=sys.stderr)
+            return 1
+    if current != committed:
+        print(f"shard_budget: {BASELINE_NAME} is stale — the mover "
+              f"census no longer reproduces the committed baseline; "
+              f"re-run `{MOVER}` and review the diff", file=sys.stderr)
+        return 1
+    print(f"shard_budget: {BASELINE_NAME} reproduces "
+          f"({cur} static sites; traced flavors "
+          f"{sorted(k for k in current['traced'] if k != 'skipped')})",
+          file=sys.stderr)
+    return 0
+
+
+def main(argv=None) -> int:
+    import argparse
+    import sys
+
+    parser = argparse.ArgumentParser(
+        prog="python -m mpi_blockchain_tpu.analysis.shard_budget",
+        description="the sanctioned SHARDBUDGET.json mover: traces the "
+                    "mesh sweep per kernel flavor (imports jax) and "
+                    "rewrites the committed collective budget; the "
+                    "chainlint gate itself stays stdlib-only")
+    parser.add_argument("--write", action="store_true",
+                        help="re-census and rewrite SHARDBUDGET.json")
+    parser.add_argument("--check", action="store_true",
+                        help="verify the committed baseline reproduces "
+                             "byte-identically (make shardbudget-check)")
+    parser.add_argument("--baseline", type=pathlib.Path, default=None,
+                        metavar="PATH",
+                        help="check/write against an alternate "
+                             "SHARDBUDGET.json (the drift-fixture seam)")
+    parser.add_argument("--root", type=pathlib.Path, default=None)
+    args = parser.parse_args(argv)
+    if not (args.write or args.check):
+        parser.error("nothing to do: pass --write or --check")
+    overrides = ({"shardbudget_json": args.baseline}
+                 if args.baseline is not None else None)
+    if args.check:
+        return check_budget(args.root, overrides)
+    try:
+        path = write_budget(args.root, overrides)
+    except (ValueError, OSError) as e:
+        print(f"shard_budget: {e}", file=sys.stderr)
+        return 2
+    print(f"shard_budget: wrote {path}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -1,0 +1,674 @@
+"""SHD rules — partition-spec & axis-context lint for the mesh sweep.
+
+ROADMAP item 1's 8-chip bring-up is gated on exactly the hazard class
+deadlint cannot see: bugs that are *silent on one device* and only
+crash (or hang) on a real multi-chip mesh. All four rules here fire on
+shapes that trace fine on CPU with a 1-device mesh:
+
+  SHD001  shard_map ``in_specs``/``out_specs`` arity mismatch against
+          the wrapped function's signature / return tuple — XLA accepts
+          a wrong-length spec tuple only until the first multi-device
+          run, and a spec that silently replicates a sharded operand
+          makes every device sweep the SAME nonce slice (the
+          silent-replication bug class: duplicated work, no error).
+  SHD002  a collective (``psum``/``pmin``/``all_gather``/
+          ``axis_index``/...) reachable from a call site with no
+          enclosing shard_map/axis context — axis-name provenance is
+          walked through the callgraph the way sync_lint walks device
+          provenance: a function whose collectives ride its own
+          ``axis_name`` parameter is fine (the caller decides), but a
+          *literal* axis name (or a parameter default) with no
+          shard_map above it is the "unbound axis name 'miners'" crash
+          that only fires on a real mesh.
+  SHD003  a rank-divergent value (``jax.process_index()``,
+          ``mesh_rank()``, ``ElasticWorld.index()``-style world
+          queries) flowing into a shape slot, a traced function's
+          static argument, or the trip count of a loop that dispatches
+          collectives/traced work — each rank then traces a DIFFERENT
+          program and the collectives inside stop lining up: the
+          multi-host hang deadlint (which sees locks and futures, not
+          traces) cannot see.
+  SHD004  a raw ``jax.shard_map``/``jax.experimental.shard_map``
+          import or attribute use outside the one sanctioned compat
+          seam ``parallel.mesh._resolve_shard_map`` — the check_vma
+          workaround must stay the single spelling, or a jax version
+          bump forks behavior between call sites.
+
+Provenance limits (documented, deliberate): SHD001 only checks literal
+spec tuples against module-local defs (``(P(),) * n`` computed arities
+are trusted — ``maybe_shard_over_miners`` derives them from the
+signature precisely so nobody hand-miscounts); SHD002's
+parameter-threading recognizes the ``axis_name`` parameter name (the
+repo-wide spelling) and one level of ``functools.partial``; SHD003's
+taint is per-function (no cross-function argument threading). The rule
+set prefers silence over false positives on host-side builder code —
+the same contract as jax_lint.
+
+Scope: ``parallel/``, ``backend/``, ``models/`` (recursive) plus
+``experiments/*.py`` (override key ``shard_files``).
+"""
+from __future__ import annotations
+
+import ast
+import pathlib
+
+from . import Finding, override_files, package_scope, rel_path, \
+    source_cached
+from .callgraph import call_name, dotted
+from .jax_lint import _collect_traced_functions
+
+SANCTIONED_SEAM_FILE = "mpi_blockchain_tpu/parallel/mesh.py"
+SANCTIONED_SEAM_FN = "_resolve_shard_map"
+
+#: Collectives + axis queries whose axis argument binds a mesh axis ->
+#: the positional slot that argument occupies (jax.lax signatures).
+AXIS_SLOTS = {"psum": 1, "pmin": 1, "pmax": 1, "pmean": 1,
+              "all_gather": 1, "all_to_all": 1, "ppermute": 1,
+              "axis_index": 0, "axis_size": 0}
+_LAX_PREFIXES = ("jax.lax", "lax")
+
+
+def _default_files(root: pathlib.Path) -> list[pathlib.Path]:
+    files = package_scope(root, ("parallel", "backend", "models"))
+    exp = root / "experiments"
+    if exp.is_dir():
+        files += sorted(exp.glob("*.py"))
+    return files
+
+
+def _is_collective(node: ast.Call) -> bool:
+    name = call_name(node)
+    if name not in AXIS_SLOTS:
+        return False
+    if isinstance(node.func, ast.Name):
+        return True
+    d = dotted(node.func)
+    return any(d == f"{p}.{name}" for p in _LAX_PREFIXES)
+
+
+def _axis_expr(node: ast.Call) -> ast.expr | None:
+    """The axis argument of a collective call, or None when absent."""
+    slot = AXIS_SLOTS.get(call_name(node))
+    if slot is not None and len(node.args) > slot:
+        return node.args[slot]
+    for kw in node.keywords:
+        if kw.arg in ("axis_name", "axis"):
+            return kw.value
+    return None
+
+
+# ---- function records ------------------------------------------------------
+
+
+class _Fn:
+    """One top-level function (nested defs folded in): its axis_name
+    parameter (if any), its default, and where its collectives bind."""
+
+    def __init__(self, rel: str, node: ast.FunctionDef):
+        self.rel = rel
+        self.node = node
+        self.name = node.name
+        # axis_name parameter: position + default, of the OUTERMOST def
+        # that declares one (the nested-closure case reads the outer
+        # parameter, which is what run()/body() in mesh.py do).
+        self.axis_index: int | None = None
+        self.axis_default: ast.expr | None = None
+        self.param_axis_names: set[str] = set()
+        for fn in self._defs():
+            args = fn.args
+            names = [a.arg for a in args.posonlyargs + args.args
+                     + args.kwonlyargs]
+            if "axis_name" in names:
+                self.param_axis_names.add("axis_name")
+                if self.axis_index is None and fn is node:
+                    pos = (args.posonlyargs + args.args)
+                    for i, a in enumerate(pos):
+                        if a.arg == "axis_name":
+                            self.axis_index = i
+                            n_def = len(args.defaults)
+                            j = i - (len(pos) - n_def)
+                            if 0 <= j < n_def:
+                                self.axis_default = args.defaults[j]
+                    if self.axis_index is None:
+                        for a, d in zip(args.kwonlyargs, args.kw_defaults):
+                            if a.arg == "axis_name":
+                                self.axis_default = d
+        # requirement state for the SHD002 fixpoint
+        self.param_req = False           # collectives ride axis_name
+        self.always_sites: list[tuple[int, str]] = []   # (line, detail)
+
+    def _defs(self):
+        for n in ast.walk(self.node):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield n
+
+    def calls(self):
+        """(call, chain) pairs — ``chain`` is the tuple of NESTED def
+        names lexically enclosing the call (used to exempt sites inside
+        a nested def that is itself shard_map-provided, the per_device
+        shape in make_mesh_sweep_fn)."""
+
+        def walk(node, chain):
+            for child in ast.iter_child_nodes(node):
+                sub = chain
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    sub = chain + (child.name,)
+                if isinstance(child, ast.Call):
+                    yield child, chain
+                yield from walk(child, sub)
+
+        yield from walk(self.node, ())
+
+
+def _top_level_functions(rel: str, tree: ast.Module) -> list[_Fn]:
+    out = []
+    for node in tree.body:
+        if isinstance(node, ast.FunctionDef):
+            out.append(_Fn(rel, node))
+        elif isinstance(node, ast.ClassDef):
+            for sub in node.body:
+                if isinstance(sub, ast.FunctionDef):
+                    out.append(_Fn(rel, sub))
+    return out
+
+
+# ---- SHD001: spec arity vs wrapped signature -------------------------------
+
+
+def _partial_target(expr: ast.expr) -> tuple[str | None, int, set[str]]:
+    """(callee name, bound positional count, bound keyword names) for a
+    shard_map arg0: a bare Name or one functools.partial() level."""
+    if isinstance(expr, ast.Name):
+        return expr.id, 0, set()
+    if isinstance(expr, ast.Call) and dotted(expr.func) in (
+            "functools.partial", "partial") and expr.args and \
+            isinstance(expr.args[0], ast.Name):
+        bound_kw = {kw.arg for kw in expr.keywords if kw.arg}
+        return expr.args[0].id, len(expr.args) - 1, bound_kw
+    return None, 0, set()
+
+
+def _own_returns(fn: ast.FunctionDef) -> list[ast.Return]:
+    """Return statements lexically in ``fn`` itself (nested defs cut)."""
+    out: list[ast.Return] = []
+
+    def walk(nodes):
+        for n in nodes:
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if isinstance(n, ast.Return):
+                out.append(n)
+            walk(ast.iter_child_nodes(n))
+
+    walk(ast.iter_child_nodes(fn))
+    return out
+
+
+def _fn_return_arity(fn: ast.FunctionDef,
+                     local_defs: dict[str, ast.FunctionDef],
+                     hop: int = 0) -> int | None:
+    """Consistent return-tuple arity of ``fn``'s own returns (nested
+    defs cut), following ONE hop of a module-local tail call — the
+    per_device -> winner_select -> 2-tuple shape in parallel/mesh.py.
+    None when any return's arity is not statically known."""
+    arities: set[int] = set()
+    for ret in _own_returns(fn):
+        if ret.value is None:
+            return None
+        v = ret.value
+        if isinstance(v, ast.Tuple):
+            arities.add(len(v.elts))
+        elif isinstance(v, ast.Call) and hop < 1 and \
+                isinstance(v.func, ast.Name) and v.func.id in local_defs:
+            inner = _fn_return_arity(local_defs[v.func.id], local_defs,
+                                     hop + 1)
+            if inner is None:
+                return None
+            arities.add(inner)
+        else:
+            return None
+    return arities.pop() if len(arities) == 1 else None
+
+
+def _shd001(rel: str, tree: ast.Module) -> list[Finding]:
+    local_defs: dict[str, ast.FunctionDef] = {}
+    for n in ast.walk(tree):
+        if isinstance(n, ast.FunctionDef):
+            local_defs.setdefault(n.name, n)
+    findings: list[Finding] = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and call_name(node) == "shard_map" and node.args):
+            continue
+        target, bound_pos, bound_kw = _partial_target(node.args[0])
+        fn = local_defs.get(target) if target else None
+        if fn is None:
+            continue
+        args = fn.args
+        params = [a.arg for a in args.posonlyargs + args.args
+                  + args.kwonlyargs]
+        unbound = [p for i, p in enumerate(params)
+                   if i >= bound_pos and p not in bound_kw]
+        specs = {kw.arg: kw.value for kw in node.keywords
+                 if kw.arg in ("in_specs", "out_specs")}
+        in_specs = specs.get("in_specs")
+        if isinstance(in_specs, ast.Tuple) and \
+                len(in_specs.elts) != len(unbound):
+            findings.append(Finding(
+                rel, node.lineno, "SHD001",
+                f"shard_map in_specs has {len(in_specs.elts)} spec(s) "
+                f"but '{target}' takes {len(unbound)} unbound "
+                f"parameter(s) {unbound} — a mis-counted spec tuple "
+                f"silently replicates (or drops) an operand and every "
+                f"device sweeps the same slice; derive the arity from "
+                f"the signature like parallel.mesh."
+                f"maybe_shard_over_miners does"))
+        out_specs = specs.get("out_specs")
+        if isinstance(out_specs, ast.Tuple):
+            ret = _fn_return_arity(fn, local_defs)
+            if ret is not None and ret != len(out_specs.elts):
+                findings.append(Finding(
+                    rel, node.lineno, "SHD001",
+                    f"shard_map out_specs has {len(out_specs.elts)} "
+                    f"spec(s) but '{target}' returns a {ret}-tuple — "
+                    f"the mismatched output spec misplaces the "
+                    f"collective epilogue's replication on a real mesh"))
+    return findings
+
+
+# ---- SHD002: axis-context provenance ---------------------------------------
+
+
+def _literal_axis(expr: ast.expr | None) -> str | None:
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return expr.value
+    if isinstance(expr, (ast.Tuple, ast.List)) and expr.elts and all(
+            isinstance(e, ast.Constant) and isinstance(e.value, str)
+            for e in expr.elts):
+        return str(expr.elts[0].value)
+    return None
+
+
+def _context_provided(trees: dict[str, ast.Module]) -> set[tuple]:
+    """(rel, fn name) wrapped by a shard_map in its module — the axis
+    context that makes literal-axis collectives legal."""
+    provided: set[tuple] = set()
+    for rel, tree in trees.items():
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) and \
+                    call_name(node) == "shard_map" and node.args:
+                target, _, _ = _partial_target(node.args[0])
+                if target:
+                    provided.add((rel, target))
+    return provided
+
+
+def _shd002(files: list[tuple[str, ast.Module]]) -> list[Finding]:
+    trees = dict(files)
+    fns: list[_Fn] = []
+    for rel, tree in files:
+        fns.extend(_top_level_functions(rel, tree))
+    by_name: dict[str, list[_Fn]] = {}
+    for f in fns:
+        by_name.setdefault(f.name, []).append(f)
+    provided = _context_provided(trees)
+
+    def site(f: _Fn, chain: tuple, line: int, detail: str) -> None:
+        # A site inside a nested def that is itself shard_map-provided
+        # (per_device in make_mesh_sweep_fn) has its context.
+        if any((f.rel, name) in provided for name in chain):
+            return
+        if (line, detail) not in f.always_sites:
+            f.always_sites.append((line, detail))
+
+    # Direct collective sites classify each function once.
+    for f in fns:
+        for call, chain in f.calls():
+            if not _is_collective(call):
+                continue
+            axis = _axis_expr(call)
+            lit = _literal_axis(axis)
+            if lit is not None:
+                site(f, chain, call.lineno,
+                     f"'{call_name(call)}' binds axis '{lit}'")
+            elif isinstance(axis, ast.Name) and \
+                    axis.id in f.param_axis_names:
+                f.param_req = True
+            # unknown axis expressions stay silent (provenance limit)
+
+    # Fixpoint: thread the axis_name parameter through named calls.
+    changed = True
+    while changed:
+        changed = False
+        for f in fns:
+            for call, chain in f.calls():
+                name = call_name(call)
+                callees = by_name.get(name, ())
+                for g in callees:
+                    if not g.param_req:
+                        continue
+                    axis = None
+                    if g.axis_index is not None and \
+                            len(call.args) > g.axis_index:
+                        axis = call.args[g.axis_index]
+                    else:
+                        for kw in call.keywords:
+                            if kw.arg == "axis_name":
+                                axis = kw.value
+                    if axis is None:
+                        axis = g.axis_default
+                    lit = _literal_axis(axis)
+                    if lit is not None:
+                        before = len(f.always_sites)
+                        site(f, chain, call.lineno,
+                             f"'{g.name}' resolves its collectives to "
+                             f"axis '{lit}' here")
+                        changed |= len(f.always_sites) != before
+                    elif isinstance(axis, ast.Name) and \
+                            axis.id in f.param_axis_names and \
+                            not f.param_req:
+                        f.param_req = True
+                        changed = True
+                    break    # one resolution per call name is enough
+
+    # Close the provided set over exclusively-inside-context callers:
+    # a helper whose every resolvable call site sits in a provided
+    # function inherits the context.
+    callers: dict[str, set[tuple]] = {}
+    for f in fns:
+        for call, chain in f.calls():
+            name = call_name(call)
+            if name in by_name:
+                owner = chain[-1] if chain else f.name
+                callers.setdefault(name, set()).add((f.rel, owner))
+    closed = set(provided)
+    grew = True
+    while grew:
+        grew = False
+        for f in fns:
+            key = (f.rel, f.name)
+            if key in closed:
+                continue
+            sites = callers.get(f.name)
+            if sites and all(s in closed for s in sites):
+                closed.add(key)
+                grew = True
+
+    findings: list[Finding] = []
+    for f in fns:
+        if (f.rel, f.name) in closed:
+            continue
+        for line, detail in f.always_sites:
+            findings.append(Finding(
+                f.rel, line, "SHD002",
+                f"collective with no enclosing shard_map/axis context: "
+                f"{detail}, but '{f.name}' is never wrapped by (or "
+                f"exclusively called from) a shard_map over that axis "
+                f"— this traces on one device and dies with an unbound "
+                f"axis name on a real mesh; thread axis_name through "
+                f"like parallel.mesh.make_round_search, or wrap the "
+                f"caller in the mesh context"))
+    # Module-level collective calls have no context by construction.
+    for rel, tree in files:
+        in_fn: set[int] = set()
+        for n in ast.walk(tree):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for sub in ast.walk(n):
+                    in_fn.add(id(sub))
+        for n in ast.walk(tree):
+            if isinstance(n, ast.Call) and id(n) not in in_fn and \
+                    _is_collective(n):
+                lit = _literal_axis(_axis_expr(n))
+                if lit is not None:
+                    findings.append(Finding(
+                        rel, n.lineno, "SHD002",
+                        f"module-level collective "
+                        f"'{call_name(n)}' binds axis '{lit}' with no "
+                        f"shard_map context — unbound axis name on any "
+                        f"real mesh"))
+    return findings
+
+
+# ---- SHD003: rank-divergent values into trace-shaping slots ----------------
+
+_RANK_CALLS = {"process_index", "mesh_rank", "process_id"}
+_WORLD_TOKENS = ("world", "elastic")
+_SHAPE_CTORS = {"zeros", "ones", "full", "empty", "arange",
+                "broadcast_to"}
+_ARRAY_NS = ("jnp", "jax.numpy", "np", "numpy")
+
+
+def _is_rank_producer(call: ast.Call) -> str | None:
+    name = call_name(call)
+    if name in _RANK_CALLS:
+        return dotted(call.func) or name
+    if name == "index" and isinstance(call.func, ast.Attribute):
+        recv = dotted(call.func.value).lower()
+        if any(tok in recv for tok in _WORLD_TOKENS):
+            return dotted(call.func)
+    return None
+
+
+def _tainted_names(fn: ast.AST) -> set[str]:
+    """Names assigned (transitively) from a rank-divergent producer,
+    per-function — a deliberate provenance limit (no cross-function
+    argument threading)."""
+    tainted: set[str] = set()
+    assigns: list[tuple[list[str], ast.expr]] = []
+    for n in ast.walk(fn):
+        if isinstance(n, ast.Assign):
+            names = [t.id for t in n.targets if isinstance(t, ast.Name)]
+            for t in n.targets:
+                if isinstance(t, ast.Tuple):
+                    names += [e.id for e in t.elts
+                              if isinstance(e, ast.Name)]
+            if names:
+                assigns.append((names, n.value))
+        elif isinstance(n, (ast.AugAssign, ast.AnnAssign)) and \
+                isinstance(n.target, ast.Name) and n.value is not None:
+            assigns.append(([n.target.id], n.value))
+
+    def dirty(expr: ast.expr) -> bool:
+        for sub in ast.walk(expr):
+            if isinstance(sub, ast.Call) and _is_rank_producer(sub):
+                return True
+            if isinstance(sub, ast.Name) and sub.id in tainted:
+                return True
+        return False
+
+    changed = True
+    while changed:
+        changed = False
+        for names, value in assigns:
+            if dirty(value) and not set(names) <= tainted:
+                tainted |= set(names)
+                changed = True
+    return tainted
+
+
+def _shd003(rel: str, tree: ast.Module) -> list[Finding]:
+    traced = {tf.node.name: tf for tf in _collect_traced_functions(tree)}
+    findings: list[Finding] = []
+    in_fn: set[int] = set()
+    for n in ast.walk(tree):
+        if isinstance(n, ast.FunctionDef):
+            for sub in ast.iter_child_nodes(n):
+                for inner in ast.walk(sub):
+                    in_fn.add(id(inner))
+    scopes: list[ast.AST] = [tree]
+    scopes += [n for n in ast.walk(tree)
+               if isinstance(n, ast.FunctionDef)]
+    seen: set[tuple[int, str]] = set()
+
+    def flag(line: int, msg: str) -> None:
+        if (line, msg) not in seen:
+            seen.add((line, msg))
+            findings.append(Finding(rel, line, "SHD003", msg))
+
+    for scope in scopes:
+        tainted = _tainted_names(scope)
+
+        def dirty(expr: ast.expr | None) -> str | None:
+            if expr is None:
+                return None
+            for sub in ast.walk(expr):
+                if isinstance(sub, ast.Call):
+                    p = _is_rank_producer(sub)
+                    if p:
+                        return p
+                if isinstance(sub, ast.Name) and sub.id in tainted:
+                    return sub.id
+            return None
+
+        module_scope = isinstance(scope, ast.Module)
+        for node in ast.walk(scope):
+            if module_scope and id(node) in in_fn:
+                continue    # function bodies get their own scope pass
+            if isinstance(node, ast.Call):
+                name = call_name(node)
+                d = dotted(node.func)
+                ns = d.rsplit(".", 1)[0] if "." in d else ""
+                if name in _SHAPE_CTORS and ns in _ARRAY_NS:
+                    cands = list(node.args[:1]) + [
+                        kw.value for kw in node.keywords
+                        if kw.arg == "shape"]
+                    for c in cands:
+                        src = dirty(c)
+                        if src:
+                            flag(node.lineno,
+                                 f"rank-divergent value '{src}' flows "
+                                 f"into the shape of '{d or name}' — "
+                                 f"each rank traces a different-shaped "
+                                 f"program and the mesh collectives "
+                                 f"stop lining up (multi-host hang)")
+                elif name == "reshape" and \
+                        isinstance(node.func, ast.Attribute):
+                    for c in node.args:
+                        src = dirty(c)
+                        if src:
+                            flag(node.lineno,
+                                 f"rank-divergent value '{src}' flows "
+                                 f"into '.reshape()' — divergent "
+                                 f"shapes diverge the traced program "
+                                 f"across ranks (multi-host hang)")
+                elif name in traced:
+                    tf = traced[name]
+                    args = tf.node.args
+                    params = [a.arg for a in args.posonlyargs
+                              + args.args]
+                    for s in tf.static:
+                        expr = None
+                        if s in params and \
+                                params.index(s) < len(node.args):
+                            expr = node.args[params.index(s)]
+                        for kw in node.keywords:
+                            if kw.arg == s:
+                                expr = kw.value
+                        src = dirty(expr)
+                        if src:
+                            flag(node.lineno,
+                                 f"rank-divergent value '{src}' is "
+                                 f"passed as static argument '{s}' of "
+                                 f"traced function '{name}' — every "
+                                 f"rank compiles a different program "
+                                 f"and the collectives inside desync "
+                                 f"(the multi-host hang deadlint "
+                                 f"cannot see)")
+            elif isinstance(node, ast.For) and \
+                    isinstance(node.iter, ast.Call) and \
+                    call_name(node.iter) == "range":
+                src = None
+                for a in node.iter.args:
+                    src = src or dirty(a)
+                if not src:
+                    continue
+                dispatches = any(
+                    isinstance(sub, ast.Call)
+                    and (_is_collective(sub)
+                         or call_name(sub) in traced)
+                    for sub in ast.walk(node))
+                if dispatches:
+                    flag(node.lineno,
+                         f"rank-divergent value '{src}' sets the trip "
+                         f"count of a loop that dispatches "
+                         f"collective/traced work — ranks run "
+                         f"different numbers of collective phases and "
+                         f"the mesh hangs at the first missing "
+                         f"rendezvous")
+    return findings
+
+
+# ---- SHD004: the single shard_map spelling ---------------------------------
+
+
+def _shd004(rel: str, tree: ast.Module) -> list[Finding]:
+    posix = rel.replace("\\", "/")
+    sanctioned = posix == SANCTIONED_SEAM_FILE
+    seam_nodes: set[int] = set()
+    if sanctioned:
+        for n in ast.walk(tree):
+            if isinstance(n, ast.FunctionDef) and \
+                    n.name == SANCTIONED_SEAM_FN:
+                for sub in ast.walk(n):
+                    seam_nodes.add(id(sub))
+    findings: list[Finding] = []
+
+    def flag(node: ast.AST, what: str) -> None:
+        if id(node) in seam_nodes:
+            return
+        findings.append(Finding(
+            rel, node.lineno, "SHD004",
+            f"raw shard_map {what} outside the sanctioned compat seam "
+            f"parallel.mesh.{SANCTIONED_SEAM_FN} — the check_vma "
+            f"workaround must stay the single spelling; import "
+            f"``shard_map`` from mpi_blockchain_tpu.parallel.mesh "
+            f"instead"))
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if "shard_map" in mod or (
+                    mod in ("jax", "jax.experimental")
+                    and any(a.name == "shard_map" for a in node.names)):
+                flag(node, f"import (`from {mod} import ...`)")
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                if "shard_map" in a.name:
+                    flag(node, f"import (`import {a.name}`)")
+        elif isinstance(node, ast.Attribute) and \
+                node.attr == "shard_map":
+            d = dotted(node)
+            if d in ("jax.shard_map", "jax.experimental.shard_map") or \
+                    d.endswith(".experimental.shard_map"):
+                flag(node, f"attribute use (`{d}`)")
+    return findings
+
+
+# ---- the pass --------------------------------------------------------------
+
+
+def run_shard_lint(root: pathlib.Path, overrides=None,
+                   notes=None) -> list[Finding]:
+    overrides = overrides or {}
+    files = override_files(overrides, "shard_files",
+                           lambda: _default_files(root))
+    findings: list[Finding] = []
+    parsed: list[tuple[str, ast.Module]] = []
+    for path in files:
+        path = pathlib.Path(path)
+        rel = rel_path(path, root)
+        try:
+            _, tree, err = source_cached(path)
+        except OSError:
+            continue
+        if tree is None:
+            findings.append(Finding(rel, err[0], "SHD000",
+                                    f"syntax error: {err[1]}"))
+            continue
+        parsed.append((rel, tree))
+        findings.extend(_shd001(rel, tree))
+        findings.extend(_shd003(rel, tree))
+        findings.extend(_shd004(rel, tree))
+    findings.extend(_shd002(parsed))
+    return findings

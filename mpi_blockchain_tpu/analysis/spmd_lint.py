@@ -52,9 +52,14 @@ the call-graph builder's known limits (docs/static_analysis.md).
 
 Scope: ``mpi_blockchain_tpu/parallel/`` and ``experiments/`` (override
 key ``spmd_files``); the canonical axis set honors the ``mesh_py``
-override shared with the JAX pass. SPMD002 overlaps JAX005 on
-``parallel/`` by design — the two passes gate different scopes and a
-drifted axis name should fail both.
+override shared with the JAX pass. SPMD002 DEFERS to JAX005 on files
+the jax pass already covers (its ``jax_files`` scope — ``ops/``,
+``models/``, ``parallel/``, honoring the same override): the two rules
+check the identical literal-axis-name drift, and double-reporting one
+edit as two findings buries real signal and forces paired
+suppressions. On files only this pass sees (``experiments/``, override
+fixtures) SPMD002 still fires, so every scoped file gets the axis
+check exactly once.
 """
 from __future__ import annotations
 
@@ -381,6 +386,17 @@ def run_spmd_lint(root: pathlib.Path, overrides=None,
     if not canonical and notes is not None:
         notes.append("spmd: no canonical mesh axes found; SPMD002 skipped")
 
+    # SPMD002 defers to JAX005 on files the jax pass already covers —
+    # same rule, one finding per drifted axis name (module docstring).
+    from .jax_lint import LINT_DIRS
+    pkg = root / "mpi_blockchain_tpu"
+    jax_covered = {
+        pathlib.Path(p).resolve()
+        for p in override_files(overrides, "jax_files",
+                                lambda: [p for d in LINT_DIRS
+                                         for p in sorted(
+                                             (pkg / d).glob("*.py"))])}
+
     findings: list[Finding] = []
     for path in files:
         path = pathlib.Path(path)
@@ -395,7 +411,7 @@ def run_spmd_lint(root: pathlib.Path, overrides=None,
             continue
         walker = _ContextWalker(rel, _collective_funcs(tree), findings)
         walker.visit(tree)
-        if canonical:
+        if canonical and path.resolve() not in jax_covered:
             findings.extend(_axis_findings(rel, tree, canonical))
     # SPMD004 scope: the elastic files, which are deliberately EXEMPT
     # from SPMD001-003 (guarded_collective + watchdog recovery is their

@@ -49,10 +49,11 @@ keys ``wait_files``, ``waitbudget_json``).
 from __future__ import annotations
 
 import ast
-import json
 import pathlib
 
 from . import Finding, override_files, rel_path, source_cached
+from .budget import (int_key_error, mover_main, read_json_object,
+                     refuse_upward, require_amendable, write_json_budget)
 from .callgraph import CallGraph, call_name, dotted
 from .conc_lint import (_MutationCollector, _is_lockish,
                         _module_level_names, _scoped_files,
@@ -60,6 +61,7 @@ from .conc_lint import (_MutationCollector, _is_lockish,
 
 BASELINE_NAME = "WAITBUDGET.json"
 REQUIRED_KEYS = ("static_wait_sites", "sites")
+MOVER = "python -m mpi_blockchain_tpu.analysis.thread_lint --write"
 
 #: The concurrent-substrate sources whose blocking-wait sites are
 #: budgeted: everything between the mine loop and the device program
@@ -161,25 +163,15 @@ def _paths(root: pathlib.Path, overrides: dict
 
 def load_baseline(baseline: pathlib.Path) -> tuple[dict | None, str]:
     """(budget dict, error message) — dict None iff invalid."""
-    try:
-        data = json.loads(baseline.read_text())
-    except OSError as e:
-        return None, f"cannot read {baseline.name}: {e}"
-    except ValueError as e:
-        return None, f"{baseline.name} is not valid JSON: {e}"
-    if not isinstance(data, dict):
-        return None, f"{baseline.name} must hold a JSON object"
-    n = data.get("static_wait_sites")
-    if not isinstance(n, int) or isinstance(n, bool) or n < 0:
-        return None, (f"{baseline.name} lacks a non-negative integer "
-                      f"'static_wait_sites' — regenerate it with "
-                      f"`python -m mpi_blockchain_tpu.analysis."
-                      f"thread_lint --write`")
+    data, err = read_json_object(baseline)
+    if data is None:
+        return None, err
+    err = int_key_error(data, baseline.name, "static_wait_sites", MOVER)
+    if err:
+        return None, err
     if not isinstance(data.get("sites"), list):
         return None, (f"{baseline.name} lacks the per-site 'sites' "
-                      f"seam record — regenerate it with "
-                      f"`python -m mpi_blockchain_tpu.analysis."
-                      f"thread_lint --write`")
+                      f"seam record — regenerate it with `{MOVER}`")
     return data, ""
 
 
@@ -463,19 +455,11 @@ def rebaseline_waits(root: pathlib.Path,
     if errors:
         raise ValueError(f"census scope has syntax errors: {errors[0]}")
     old_data, err = load_baseline(baseline_path)
-    if old_data is None:
-        raise ValueError(
-            f"no valid baseline to amend ({err}); bootstrap the budget "
-            f"with `python -m mpi_blockchain_tpu.analysis.thread_lint "
-            f"--write`")
+    old_data = require_amendable(old_data, err, MOVER)
     old = old_data["static_wait_sites"]
-    if total > old:
-        raise ValueError(
-            f"refusing to rebaseline upward: static wait census {total} "
-            f"> committed budget {old}. Blocking-wait sites only "
-            f"ratchet down; a justified increase must go through "
-            f"`python -m mpi_blockchain_tpu.analysis.thread_lint "
-            f"--write` and a reviewed WAITBUDGET.json diff")
+    refuse_upward(total, old, census_label="static wait census",
+                  policy="Blocking-wait sites only ratchet down",
+                  mover=MOVER, baseline_name=BASELINE_NAME)
     data = dict(old_data)
     data["static_wait_sites"] = total
     data["by_label"] = dict(sorted(by_label.items()))
@@ -483,8 +467,7 @@ def rebaseline_waits(root: pathlib.Path,
     # Same ordering as write_budget (WAIT_SCOPE declaration order), so
     # a ratchet-down never reorders the committed review surface.
     data["scope"] = [rel_path(pathlib.Path(p), root) for p in readable]
-    baseline_path.write_text(json.dumps(data, indent=1, sort_keys=True)
-                             + "\n")
+    write_json_budget(baseline_path, data)
     return old, total, baseline_path
 
 
@@ -506,37 +489,22 @@ def write_budget(root: pathlib.Path | None = None,
         "by_label": dict(sorted(by_label.items())),
         "sites": sites,
         "scope": [rel_path(pathlib.Path(p), root) for p in readable],
-        "writer": ("python -m mpi_blockchain_tpu.analysis."
-                   "thread_lint --write"),
+        "writer": MOVER,
     }
-    baseline_path.write_text(json.dumps(data, indent=1, sort_keys=True)
-                             + "\n")
+    write_json_budget(baseline_path, data)
     return baseline_path
 
 
 def main(argv=None) -> int:
-    import argparse
-    import sys
-
-    parser = argparse.ArgumentParser(
+    return mover_main(
+        argv,
         prog="python -m mpi_blockchain_tpu.analysis.thread_lint",
         description="the sanctioned WAITBUDGET.json mover: re-censuses "
                     "the sweep scope's blocking-wait sites (with their "
                     "sanctioning seams) and rewrites the committed "
-                    "budget")
-    parser.add_argument("--write", action="store_true",
-                        help="re-census and rewrite WAITBUDGET.json")
-    parser.add_argument("--root", type=pathlib.Path, default=None)
-    args = parser.parse_args(argv)
-    if not args.write:
-        parser.error("nothing to do: pass --write")
-    try:
-        path = write_budget(args.root)
-    except (ValueError, OSError) as e:
-        print(f"thread_lint: {e}", file=sys.stderr)
-        return 2
-    print(f"thread_lint: wrote {path}", file=sys.stderr)
-    return 0
+                    "budget",
+        write_help="re-census and rewrite WAITBUDGET.json",
+        label="thread_lint", writer=write_budget)
 
 
 if __name__ == "__main__":

@@ -51,14 +51,16 @@ Override keys: ``transferbudget_json`` (baseline path),
 from __future__ import annotations
 
 import ast
-import json
 import pathlib
 
 from . import Finding, override_files, rel_path
+from .budget import (int_key_error, mover_main, read_json_object,
+                     refuse_upward, require_amendable, write_json_budget)
 from .callgraph import call_name, dotted
 
 BASELINE_NAME = "TRANSFERBUDGET.json"
 REQUIRED_KEYS = ("static_transfer_sites", "traced")
+MOVER = "python -m mpi_blockchain_tpu.analysis.transfer_budget --write"
 
 #: The sweep-path sources whose transfer sites are budgeted (the files
 #: between the mine-loop entry points and the device program).
@@ -138,25 +140,16 @@ def _paths(root: pathlib.Path, overrides: dict
 
 def load_baseline(baseline: pathlib.Path) -> tuple[dict | None, str]:
     """(budget dict, error message) — dict None iff invalid."""
-    try:
-        data = json.loads(baseline.read_text())
-    except OSError as e:
-        return None, f"cannot read {baseline.name}: {e}"
-    except ValueError as e:
-        return None, f"{baseline.name} is not valid JSON: {e}"
-    if not isinstance(data, dict):
-        return None, f"{baseline.name} must hold a JSON object"
-    sites = data.get("static_transfer_sites")
-    if not isinstance(sites, int) or isinstance(sites, bool) or sites < 0:
-        return None, (f"{baseline.name} lacks a non-negative integer "
-                      f"'static_transfer_sites' — regenerate it with "
-                      f"`python -m mpi_blockchain_tpu.analysis."
-                      f"transfer_budget --write`")
+    data, err = read_json_object(baseline)
+    if data is None:
+        return None, err
+    err = int_key_error(data, baseline.name, "static_transfer_sites",
+                        MOVER)
+    if err:
+        return None, err
     if not isinstance(data.get("traced"), dict):
         return None, (f"{baseline.name} lacks the 'traced' per-flavor "
-                      f"jaxpr census — regenerate it with "
-                      f"`python -m mpi_blockchain_tpu.analysis."
-                      f"transfer_budget --write`")
+                      f"jaxpr census — regenerate it with `{MOVER}`")
     return data, ""
 
 
@@ -217,19 +210,11 @@ def rebaseline_transfers(root: pathlib.Path,
     if errors:
         raise ValueError(f"census scope has syntax errors: {errors[0]}")
     old_data, err = load_baseline(baseline_path)
-    if old_data is None:
-        raise ValueError(
-            f"no valid baseline to amend ({err}); bootstrap the budget "
-            f"with `python -m mpi_blockchain_tpu.analysis."
-            f"transfer_budget --write`")
+    old_data = require_amendable(old_data, err, MOVER)
     old = old_data["static_transfer_sites"]
-    if total > old:
-        raise ValueError(
-            f"refusing to rebaseline upward: static transfer census "
-            f"{total} > committed budget {old}. Transfers only ratchet "
-            f"down; a justified increase must go through "
-            f"`python -m mpi_blockchain_tpu.analysis.transfer_budget "
-            f"--write` and a reviewed TRANSFERBUDGET.json diff")
+    refuse_upward(total, old, census_label="static transfer census",
+                  policy="Transfers only ratchet down",
+                  mover=MOVER, baseline_name=BASELINE_NAME)
     data = dict(old_data)
     data["static_transfer_sites"] = total
     data["static_by_site"] = dict(sorted(by_label.items()))
@@ -237,8 +222,7 @@ def rebaseline_transfers(root: pathlib.Path,
     # the committed review surface misstates the budget's coverage.
     data["scope"] = [rel_path(pathlib.Path(p), root) for p in
                      sorted(pathlib.Path(f) for f in readable)]
-    baseline_path.write_text(json.dumps(data, indent=1, sort_keys=True)
-                             + "\n")
+    write_json_budget(baseline_path, data)
     return old, total, baseline_path
 
 
@@ -325,37 +309,22 @@ def write_budget(root: pathlib.Path | None = None,
         "static_by_site": dict(sorted(by_label.items())),
         "scope": [rel_path(pathlib.Path(p), root) for p in readable],
         "traced": trace_transfer_census(),
-        "writer": ("python -m mpi_blockchain_tpu.analysis."
-                   "transfer_budget --write"),
+        "writer": MOVER,
     }
-    baseline_path.write_text(json.dumps(data, indent=1, sort_keys=True)
-                             + "\n")
+    write_json_budget(baseline_path, data)
     return baseline_path
 
 
 def main(argv=None) -> int:
-    import argparse
-    import sys
-
-    parser = argparse.ArgumentParser(
+    return mover_main(
+        argv,
         prog="python -m mpi_blockchain_tpu.analysis.transfer_budget",
         description="the sanctioned TRANSFERBUDGET.json mover: traces "
                     "the sweep callables (imports jax) and rewrites "
                     "the committed budget; the chainlint gate itself "
-                    "stays stdlib-only")
-    parser.add_argument("--write", action="store_true",
-                        help="re-census and rewrite TRANSFERBUDGET.json")
-    parser.add_argument("--root", type=pathlib.Path, default=None)
-    args = parser.parse_args(argv)
-    if not args.write:
-        parser.error("nothing to do: pass --write")
-    try:
-        path = write_budget(args.root)
-    except (ValueError, OSError) as e:
-        print(f"transfer_budget: {e}", file=sys.stderr)
-        return 2
-    print(f"transfer_budget: wrote {path}", file=sys.stderr)
-    return 0
+                    "stays stdlib-only",
+        write_help="re-census and rewrite TRANSFERBUDGET.json",
+        label="transfer_budget", writer=write_budget)
 
 
 if __name__ == "__main__":

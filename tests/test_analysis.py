@@ -1769,6 +1769,23 @@ def _thr_case(tmp_path):
     return {"thread_files": [path]}, "THR001", path, "# "
 
 
+def _shd_case(tmp_path):
+    path = tmp_path / "bad_shard_mod.py"
+    path.write_text("from jax.experimental.shard_map import shard_map\n")
+    return {"shard_files": [path]}, "SHD004", path, "# "
+
+
+def _sbd_case(tmp_path):
+    budget = tmp_path / "SHARDBUDGET.json"
+    budget.write_text(json.dumps({"static_collective_sites": 0,
+                                  "traced": {}}))
+    src = tmp_path / "collect.py"
+    src.write_text("import jax\n\n\ndef winner(c):\n"
+                   "    return jax.lax.psum(c, 'miners')\n")
+    return ({"shardbudget_json": budget, "shard_files": [src]},
+            "SBD001", src, "# ")
+
+
 MATRIX_CASES = {
     "binding": _capi_case, "header": _chain_hpp_case, "jax": _jax_case,
     "sanitizers": _san_case, "telemetry": _tel_case,
@@ -1776,6 +1793,7 @@ MATRIX_CASES = {
     "hotpath": _hot_case, "opbudget": _opb_case, "sync": _sync_case,
     "don": _don_case, "trb": _trb_case, "lock": _lck_case,
     "future": _fut_case, "thread": _thr_case,
+    "shard": _shd_case, "sbd": _sbd_case,
 }
 
 
@@ -3742,3 +3760,629 @@ def test_source_cache_tracks_rewrites(tmp_path):
     p.write_text("z = (\n")
     _, t3, err = source_cached(p)
     assert t3 is None and err[0] >= 1
+
+
+# ---- SHD: shardlint — partition-spec & axis-context --------------------
+
+
+def _shd(tmp_path, text, name="shard_mod.py"):
+    from mpi_blockchain_tpu.analysis.shard_lint import run_shard_lint
+
+    path = tmp_path / name
+    path.write_text(text)
+    return run_shard_lint(ROOT, overrides={"shard_files": [path]})
+
+
+def test_shd001_in_spec_arity_fires(tmp_path):
+    findings = _shd(tmp_path, textwrap.dedent("""\
+        from jax.sharding import PartitionSpec as P
+
+
+        def per_device(base, nonce):
+            return base + nonce, nonce
+
+
+        def build(mesh):
+            return shard_map(per_device, mesh=mesh,
+                             in_specs=(P("miners"),),
+                             out_specs=(P(), P()))
+        """))
+    assert [f.rule for f in findings] == ["SHD001"], \
+        "\n".join(f.render() for f in findings)
+    assert "1 spec(s)" in findings[0].message
+    assert "2 unbound parameter(s)" in findings[0].message
+
+
+def test_shd001_out_spec_arity_fires(tmp_path):
+    findings = _shd(tmp_path, textwrap.dedent("""\
+        from jax.sharding import PartitionSpec as P
+
+
+        def per_device(base):
+            return base, base, base
+
+
+        def build(mesh):
+            return shard_map(per_device, mesh=mesh,
+                             in_specs=(P("miners"),),
+                             out_specs=(P(), P()))
+        """))
+    assert [f.rule for f in findings] == ["SHD001"]
+    assert "returns a 3-tuple" in findings[0].message
+
+
+def test_shd001_partial_bound_params_excused(tmp_path):
+    """functools.partial-bound parameters do not count toward the spec
+    arity — the maybe_shard_over_miners wrapper binds config kwargs."""
+    findings = _shd(tmp_path, textwrap.dedent("""\
+        import functools
+
+        from jax.sharding import PartitionSpec as P
+
+
+        def per_device(base, nonce, difficulty):
+            return base + nonce + difficulty
+
+
+        def build(mesh):
+            f = functools.partial(per_device, difficulty=12)
+            return shard_map(
+                functools.partial(per_device, difficulty=12),
+                mesh=mesh, in_specs=(P("miners"), P()),
+                out_specs=P())
+        """))
+    assert findings == []
+
+
+def test_shd001_computed_spec_tuple_trusted(tmp_path):
+    """`(P(),) * n` signature-derived spec tuples (the live
+    maybe_shard_over_miners plumbing) are trusted, not guessed at."""
+    findings = _shd(tmp_path, textwrap.dedent("""\
+        from jax.sharding import PartitionSpec as P
+
+
+        def per_device(base, nonce):
+            return base, nonce
+
+
+        def build(mesh, n_in):
+            return shard_map(per_device, mesh=mesh,
+                             in_specs=(P(),) * n_in,
+                             out_specs=(P(), P()))
+        """))
+    assert findings == []
+
+
+BAD_SHD002 = textwrap.dedent("""\
+    import jax
+
+
+    def winner_select(count, nonce, axis_name="miners"):
+        total = jax.lax.psum(count, axis_name)
+        best = jax.lax.pmin(nonce, axis_name)
+        return total, best
+
+
+    def host_summary(counts, nonces):
+        return winner_select(counts, nonces)
+    """)
+
+
+def test_shd002_unwrapped_default_axis_fires(tmp_path):
+    """The multi-chip hang shape: winner_select's collectives resolve to
+    the literal default axis 'miners' at an unwrapped call site — traces
+    fine on one device, unbound axis name on a real mesh."""
+    findings = _shd(tmp_path, BAD_SHD002)
+    assert [f.rule for f in findings] == ["SHD002"], \
+        "\n".join(f.render() for f in findings)
+    assert findings[0].line == 11
+    assert "winner_select" in findings[0].message
+    assert "'miners'" in findings[0].message
+
+
+def test_shd002_hang_shape_invisible_to_deadlint_and_synclint(tmp_path):
+    """The acceptance shape: the SHD002 fixture reproduces a real
+    multi-chip hang that BOTH deadlint (locks/futures/threads — there
+    are none here) and synclint (device-sync provenance — no sync
+    either) are blind to. Only shardlint sees it."""
+    path = tmp_path / "hang.py"
+    path.write_text(BAD_SHD002)
+    blind = run_all(
+        root=ROOT, passes=["lock", "future", "thread", "sync", "don"],
+        overrides={"lock_files": [path], "future_files": [path],
+                   "thread_files": [path], "wait_files": [path],
+                   "sync_files": [path], "donation_files": [path]})
+    # SYNC003 is sync_lint's scope-sanity rule (the overridden file set
+    # lacks the live entry points) — not a finding about the fixture.
+    blind = [f for f in blind if f.rule != "SYNC003"]
+    assert blind == [], "\n".join(f.render() for f in blind)
+    seen = run_all(root=ROOT, passes=["shard"],
+                   overrides={"shard_files": [path]})
+    assert [f.rule for f in seen] == ["SHD002"]
+
+
+def test_shd002_literal_axis_unwrapped_fires(tmp_path):
+    findings = _shd(tmp_path, textwrap.dedent("""\
+        import jax
+
+
+        def tally(count):
+            return jax.lax.psum(count, "miners")
+        """))
+    assert [f.rule for f in findings] == ["SHD002"]
+    assert "'psum' binds axis 'miners'" in findings[0].message
+
+
+def test_shd002_shard_map_wrapped_clean(tmp_path):
+    """Direct wrap AND the exclusively-called-from-wrapped closure."""
+    findings = _shd(tmp_path, textwrap.dedent("""\
+        import jax
+
+
+        def winner_select(count, axis_name="miners"):
+            return jax.lax.psum(count, axis_name)
+
+
+        def per_device(base, nonce):
+            idx = jax.lax.axis_index("miners")
+            return winner_select(base + idx)
+
+
+        def build(mesh):
+            return shard_map(per_device, mesh=mesh,
+                             in_specs=None, out_specs=None)
+        """))
+    assert findings == []
+
+
+def test_shd002_dual_mode_axis_none_clean(tmp_path):
+    """The live make_round_search shape: collectives ride an axis_name
+    parameter that defaults to None — the single-chip path legitimately
+    runs collective-free, the mesh path threads the axis. No finding."""
+    findings = _shd(tmp_path, textwrap.dedent("""\
+        import jax
+
+
+        def winner_select(count, axis_name="miners"):
+            return jax.lax.psum(count, axis_name)
+
+
+        def make_round_search(mesh=None, axis_name=None):
+            def run(count):
+                return winner_select(count, axis_name)
+            return run
+        """))
+    assert findings == []
+
+
+def test_shd002_module_level_collective_fires(tmp_path):
+    findings = _shd(tmp_path, "import jax\n\n"
+                    "X = jax.lax.axis_index('miners')\n")
+    assert [f.rule for f in findings] == ["SHD002"]
+    assert "module-level" in findings[0].message
+
+
+BAD_SHD003 = textwrap.dedent("""\
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+
+    @functools.partial(jax.jit, static_argnames=("n_rounds",))
+    def sweep(base, n_rounds):
+        return base * n_rounds
+
+
+    def launch(base):
+        rank = jax.process_index()
+        out = sweep(base, n_rounds=rank + 1)
+        buf = jnp.zeros(rank + 4)
+        for _ in range(rank):
+            out = sweep(out, n_rounds=2)
+        return out, buf
+    """)
+
+
+def test_shd003_rank_divergent_trace_shapes_fire(tmp_path):
+    findings = _shd(tmp_path, BAD_SHD003)
+    assert [f.rule for f in findings] == ["SHD003"] * 3, \
+        "\n".join(f.render() for f in findings)
+    msgs = {f.line: f.message for f in findings}
+    assert "static argument 'n_rounds'" in msgs[14]
+    assert "shape of 'jnp.zeros'" in msgs[15]
+    assert "trip count" in msgs[16]
+
+
+def test_shd003_world_index_producer_fires(tmp_path):
+    findings = _shd(tmp_path, textwrap.dedent("""\
+        import jax.numpy as jnp
+
+
+        def stripe(world, width):
+            return jnp.arange(world.index() * width)
+        """))
+    assert [f.rule for f in findings] == ["SHD003"]
+    assert "world.index" in findings[0].message
+
+
+def test_shd003_rank_in_plain_host_math_clean(tmp_path):
+    """Rank-divergent values are fine everywhere EXCEPT trace-shaping
+    slots — stripe offsets (traced-value math) are the whole point of
+    ranked mining."""
+    findings = _shd(tmp_path, textwrap.dedent("""\
+        import jax
+        import jax.numpy as jnp
+
+
+        def stripe_base(width):
+            rank = jax.process_index()
+            start = rank * width
+            log = [start]
+            return jnp.uint32(start)
+        """))
+    assert findings == []
+
+
+def test_shd004_raw_imports_and_attribute_fire(tmp_path):
+    findings = _shd(tmp_path, textwrap.dedent("""\
+        from jax.experimental.shard_map import shard_map
+        import jax
+
+
+        def use(f, mesh):
+            return jax.experimental.shard_map.shard_map(f, mesh=mesh)
+        """))
+    assert sorted(f.rule for f in findings) == ["SHD004", "SHD004"], \
+        "\n".join(f.render() for f in findings)
+    assert any("import" in f.message for f in findings)
+    assert any("attribute use" in f.message for f in findings)
+    assert all("_resolve_shard_map" in f.message for f in findings)
+
+
+def test_shd_live_tree_raw_clean():
+    """parallel/ + backend/ + models/ + experiments/ are SHD raw-clean:
+    the sanctioned seam exemption covers mesh.py's compat shim, and the
+    live spec plumbing / axis threading pass their own lint."""
+    from mpi_blockchain_tpu.analysis.shard_lint import run_shard_lint
+
+    findings = run_shard_lint(ROOT)
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_shd_live_mesh_clean_shapes_pin():
+    """The two live clean shapes the rules were tuned against stay
+    recognized: maybe_shard_over_miners's signature-derived specs and
+    make_round_search's axis_name=None dual-mode run."""
+    from mpi_blockchain_tpu.analysis.shard_lint import run_shard_lint
+
+    mesh_py = ROOT / "mpi_blockchain_tpu" / "parallel" / "mesh.py"
+    src = mesh_py.read_text()
+    assert "(P(),) * n_in" in src       # the spec plumbing SHD001 trusts
+    assert "axis_name=None" in src      # the dual-mode default SHD002 allows
+    findings = run_shard_lint(ROOT, overrides={"shard_files": [mesh_py]})
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_shd004_sanctioned_seam_is_the_only_raw_import():
+    """The compat seam exists, is in the sanctioned file, and a COPY of
+    mesh.py under any other path immediately fires SHD004 — the seam is
+    positional, not a blanket allowance."""
+    from mpi_blockchain_tpu.analysis.shard_lint import run_shard_lint
+
+    mesh_py = ROOT / "mpi_blockchain_tpu" / "parallel" / "mesh.py"
+    assert "def _resolve_shard_map" in mesh_py.read_text()
+    import shutil
+    import tempfile
+    with tempfile.TemporaryDirectory() as td:
+        copy = pathlib.Path(td) / "mesh_copy.py"
+        shutil.copyfile(mesh_py, copy)
+        findings = run_shard_lint(ROOT, overrides={"shard_files": [copy]})
+    assert "SHD004" in {f.rule for f in findings}
+
+
+# ---- SBD: the collective-site budget ratchet ---------------------------
+
+
+def _shard_budget_json(tmp_path, **over):
+    data = {"static_collective_sites": 999, "traced": {}, **over}
+    path = tmp_path / "SHARDBUDGET.json"
+    path.write_text(json.dumps(data))
+    return path
+
+
+def _shard_src(tmp_path):
+    src = tmp_path / "collectives.py"
+    src.write_text("import jax\n\n\n"
+                   "def winner_select(c, n, axis_name='miners'):\n"
+                   "    total = jax.lax.psum(c, axis_name)\n"
+                   "    best = jax.lax.pmin(n, axis_name)\n"
+                   "    return total, best\n")
+    return src
+
+
+def test_sbd_live_tree_gate_is_armed_and_green():
+    from mpi_blockchain_tpu.analysis.shard_budget import run_shard_budget
+
+    assert (ROOT / "SHARDBUDGET.json").is_file(), \
+        "the committed SHARDBUDGET.json is the collective-site ratchet"
+    assert run_shard_budget(ROOT) == []
+    data = json.loads((ROOT / "SHARDBUDGET.json").read_text())
+    assert data["static_collective_sites"] == len(data["sites"]) > 0
+    # Every live collective site sits in parallel/mesh.py — the whole
+    # cross-chip contract lives behind the winner_select seam.
+    assert all(s["file"].endswith("parallel/mesh.py")
+               for s in data["sites"])
+    assert data["static_by_site"]["psum"] == 1
+    assert data["static_by_site"]["pmin"] == 1
+
+
+def test_sbd_traced_census_pins_two_collective_invariant():
+    """The ARCHITECTURE 'sharding contract': exactly one psum + one pmin
+    per mesh sweep dispatch, axes ('miners',), 8 replicated payload
+    bytes — the committed traced census IS the invariant."""
+    data = json.loads((ROOT / "SHARDBUDGET.json").read_text())
+    jnp_flavor = data["traced"]["jnp"]
+    assert jnp_flavor["primitives"]["psum"] == 1
+    assert jnp_flavor["primitives"]["pmin"] == 1
+    assert jnp_flavor["collective_total"] == 2
+    assert jnp_flavor["axis_names"] == ["miners"]
+    assert jnp_flavor["replicated_payload_bytes"] == 8
+    # Flavors untraceable on the mover's platform are recorded, not
+    # silently dropped — a CPU mover run reproduces byte-identically.
+    skipped = data["traced"].get("skipped", {})
+    assert "pallas" not in data["traced"] or "pallas" not in skipped
+
+
+def test_sbd_grown_census_fires_sbd001_with_delta(tmp_path):
+    from mpi_blockchain_tpu.analysis.shard_budget import run_shard_budget
+
+    budget = _shard_budget_json(tmp_path, static_collective_sites=1)
+    src = _shard_src(tmp_path)
+    findings = run_shard_budget(
+        ROOT, overrides={"shardbudget_json": budget,
+                         "shard_files": [src]})
+    assert [f.rule for f in findings] == ["SBD001"], \
+        "\n".join(f.render() for f in findings)
+    f = findings[0]
+    assert f.file == str(src) and f.line == 5
+    assert "RATCHET INCREASE" in f.message
+    assert "2 > budget 1" in f.message
+    assert "delta +1" in f.message
+    assert "pmin×1, psum×1" in f.message
+
+
+def test_sbd_missing_or_malformed_baseline_fires_sbd002(tmp_path):
+    from mpi_blockchain_tpu.analysis.shard_budget import run_shard_budget
+
+    for budget in (tmp_path / "absent.json",
+                   _shard_budget_json(tmp_path,
+                                      static_collective_sites=-2)):
+        findings = run_shard_budget(
+            ROOT, overrides={"shardbudget_json": budget})
+        assert [f.rule for f in findings] == ["SBD002"], findings
+    notraced = tmp_path / "notraced.json"
+    notraced.write_text(json.dumps({"static_collective_sites": 5}))
+    findings = run_shard_budget(
+        ROOT, overrides={"shardbudget_json": notraced})
+    assert [f.rule for f in findings] == ["SBD002"], findings
+    assert "traced" in findings[0].message
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    findings = run_shard_budget(
+        ROOT, overrides={"shardbudget_json": bad})
+    assert [f.rule for f in findings] == ["SBD002"], findings
+
+
+def test_sbd_empty_scope_fires_sbd003(tmp_path):
+    from mpi_blockchain_tpu.analysis.shard_budget import run_shard_budget
+
+    budget = _shard_budget_json(tmp_path)
+    findings = run_shard_budget(
+        ROOT, overrides={"shardbudget_json": budget,
+                         "shard_files": [tmp_path / "gone.py"]})
+    assert [f.rule for f in findings] == ["SBD003"], findings
+    assert "SHARD_SCOPE" in findings[0].message
+
+
+def test_sbd_rebaseline_refuses_upward(tmp_path):
+    from mpi_blockchain_tpu.analysis.shard_budget import rebaseline_shards
+
+    budget = _shard_budget_json(tmp_path, static_collective_sites=0)
+    src = _shard_src(tmp_path)
+    with pytest.raises(ValueError, match="refusing to rebaseline"):
+        rebaseline_shards(ROOT, {"shardbudget_json": budget,
+                                 "shard_files": [src]})
+    assert json.loads(budget.read_text())["static_collective_sites"] == 0
+
+
+def test_sbd_rebaseline_ratchets_down(tmp_path):
+    from mpi_blockchain_tpu.analysis.shard_budget import (
+        rebaseline_shards, run_shard_budget)
+
+    budget = _shard_budget_json(tmp_path, static_collective_sites=7,
+                                traced={"jnp": {"collective_total": 2}},
+                                note="keep me")
+    src = _shard_src(tmp_path)
+    old, new, path = rebaseline_shards(
+        ROOT, {"shardbudget_json": budget, "shard_files": [src]})
+    assert (old, new) == (7, 2)
+    data = json.loads(path.read_text())
+    assert data["static_collective_sites"] == 2
+    assert data["static_by_site"] == {"pmin": 1, "psum": 1}
+    assert [s["label"] for s in data["sites"]] == ["psum", "pmin"]
+    # Unrelated keys — including the mover-owned traced census —
+    # survive a static-only rebaseline.
+    assert data["note"] == "keep me"
+    assert data["traced"] == {"jnp": {"collective_total": 2}}
+    assert run_shard_budget(
+        ROOT, overrides={"shardbudget_json": path,
+                         "shard_files": [src]}) == []
+
+
+def test_sbd_rebaseline_requires_valid_baseline(tmp_path):
+    from mpi_blockchain_tpu.analysis.shard_budget import rebaseline_shards
+
+    src = _shard_src(tmp_path)
+    with pytest.raises(ValueError, match="no valid baseline"):
+        rebaseline_shards(ROOT,
+                          {"shardbudget_json": tmp_path / "absent.json",
+                           "shard_files": [src]})
+
+
+def test_sbd_cli_rebaseline_refusal_exits_2(tmp_path):
+    budget = _shard_budget_json(tmp_path, static_collective_sites=0)
+    src = _shard_src(tmp_path)
+    proc = subprocess.run(
+        [sys.executable, "-m", "mpi_blockchain_tpu.analysis",
+         "--rebaseline-shards",
+         "--override", f"shardbudget_json={budget}",
+         "--override", f"shard_files={src}"],
+        cwd=ROOT, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+    assert "refused" in proc.stderr
+
+
+def test_sbd_cli_pass_family(tmp_path):
+    budget = _shard_budget_json(tmp_path, static_collective_sites=0)
+    src = _shard_src(tmp_path)
+    proc = subprocess.run(
+        [sys.executable, "-m", "mpi_blockchain_tpu.analysis",
+         "--passes", "sbd",
+         "--override", f"shardbudget_json={budget}",
+         "--override", f"shard_files={src}"],
+        cwd=ROOT, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "SBD001" in proc.stdout and "RATCHET INCREASE" in proc.stdout
+
+
+def test_sbd_host_gather_on_sweep_path_fails_gate(tmp_path):
+    """THE acceptance shape: a refactor that adds a host gather to the
+    sweep path (an all_gather next to winner_select) fails the gate
+    loudly — rc 1, delta, RATCHET INCREASE — against the COMMITTED
+    live budget."""
+    mesh_py = ROOT / "mpi_blockchain_tpu" / "parallel" / "mesh.py"
+    grown = tmp_path / "mesh_grown.py"
+    grown.write_text(
+        mesh_py.read_text()
+        + "\n\ndef gather_all_counts(count, axis_name=\"miners\"):\n"
+          "    return jax.lax.all_gather(count, axis_name)\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "mpi_blockchain_tpu.analysis",
+         "--passes", "sbd",
+         "--override", f"shard_files={grown}"],
+        cwd=ROOT, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "RATCHET INCREASE" in proc.stdout
+    assert "6 > budget 5" in proc.stdout
+    assert "delta +1" in proc.stdout
+    assert "all_gather" in proc.stdout
+
+
+def test_sbd_mover_rerun_reproduces_committed_byte_identically(tmp_path):
+    """The shardbudget-check contract, in-process: re-running the full
+    mover census (static + traced, jax import and all) on the clean
+    tree reproduces the committed SHARDBUDGET.json byte-for-byte."""
+    from mpi_blockchain_tpu.analysis.shard_budget import write_budget
+
+    out = tmp_path / "SHARDBUDGET.json"
+    write_budget(ROOT, {"shardbudget_json": out})
+    assert out.read_bytes() == (ROOT / "SHARDBUDGET.json").read_bytes()
+
+
+def test_sbd_check_cli_flags_ratchet_increase(tmp_path):
+    """`make shardbudget-check`'s monotonicity guard, mirroring
+    opbudget-check: a committed budget LOWER than what the tree
+    regenerates fails loudly with the delta and the ratchet callout."""
+    committed = json.loads((ROOT / "SHARDBUDGET.json").read_text())
+    committed["static_collective_sites"] -= 1
+    tampered = tmp_path / "SHARDBUDGET.json"
+    tampered.write_text(json.dumps(committed, indent=1, sort_keys=True)
+                        + "\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "mpi_blockchain_tpu.analysis.shard_budget",
+         "--check", "--baseline", str(tampered)],
+        cwd=ROOT, capture_output=True, text=True, timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "RATCHET INCREASE" in proc.stderr
+    assert "5 > committed 4" in proc.stderr
+
+
+# ---- v5 families: engine integration -----------------------------------
+
+
+def test_spmd002_defers_to_jax005(tmp_path):
+    """One drifted axis name = exactly ONE finding: JAX005 where the jax
+    pass covers the file, SPMD002 where only the spmd pass sees it."""
+    path = tmp_path / "drift.py"
+    path.write_text("import jax\n\n\ndef bad_axis(x):\n"
+                    "    return jax.lax.psum(x, 'rows')\n")
+    both = run_all(root=ROOT, passes=["spmd", "jax"],
+                   overrides={"spmd_files": [path], "jax_files": [path],
+                              "mesh_py": MESH_PY})
+    axis = [f for f in both if f.rule in ("SPMD002", "JAX005")]
+    assert [f.rule for f in axis] == ["JAX005"], \
+        "\n".join(f.render() for f in axis)
+    spmd_only = run_all(root=ROOT, passes=["spmd"],
+                        overrides={"spmd_files": [path],
+                                   "mesh_py": MESH_PY})
+    assert "SPMD002" in {f.rule for f in spmd_only}
+
+
+def test_audit_reports_stale_v5_suppressions(tmp_path):
+    from mpi_blockchain_tpu.analysis import audit_suppressions
+
+    root, pkg = _audit_root(tmp_path)
+    mod = pkg / "mod.py"
+    mod.write_text("a = 1  # chainlint: disable=SHD004\n"
+                   "b = 2  # chainlint: disable=SBD001\n")
+    budget = _shard_budget_json(tmp_path)
+    warnings = audit_suppressions(
+        root=root, passes=["shard", "sbd"],
+        overrides={"shard_files": [mod], "shardbudget_json": budget})
+    assert len(warnings) == 2, warnings
+    for rule in ("SHD004", "SBD001"):
+        assert any(rule in w for w in warnings), (rule, warnings)
+
+
+def test_cli_json_timings_include_v5_passes(tmp_path):
+    proc = subprocess.run(
+        [sys.executable, "-m", "mpi_blockchain_tpu.analysis",
+         "--passes", "shard,sbd", "--json", "-q"],
+        cwd=ROOT, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert payload["findings"] == []
+    assert set(payload["pass_timings_ms"]) == {"shard", "sbd"}
+    assert all(t >= 0 for t in payload["pass_timings_ms"].values())
+
+
+def test_families_for_changed_v5_scoping():
+    from mpi_blockchain_tpu.analysis import families_for_changed
+
+    got = families_for_changed(["SHARDBUDGET.json"])
+    assert "sbd" in got and "shard" not in got
+    got = families_for_changed(["mpi_blockchain_tpu/parallel/mesh.py"])
+    assert {"shard", "sbd", "spmd", "jax", "trb"} <= set(got)
+    got = families_for_changed(["mpi_blockchain_tpu/backend/tpu.py"])
+    assert {"shard", "sbd", "sync", "don"} <= set(got)
+    assert "spmd" not in got
+
+
+def test_sibling_movers_reproduce_committed_budgets(tmp_path):
+    """Satellite contract: the OTHER three sanctioned movers, re-run on
+    the final tree, still reproduce their committed baselines
+    byte-for-byte (the budget.py port changed no bytes)."""
+    from mpi_blockchain_tpu.analysis.thread_lint import \
+        write_budget as write_waits
+    from mpi_blockchain_tpu.analysis.transfer_budget import \
+        write_budget as write_transfers
+
+    out = tmp_path / "WAITBUDGET.json"
+    write_waits(ROOT, {"waitbudget_json": out})
+    assert out.read_bytes() == (ROOT / "WAITBUDGET.json").read_bytes()
+    out = tmp_path / "TRANSFERBUDGET.json"
+    write_transfers(ROOT, {"transferbudget_json": out})
+    assert out.read_bytes() == \
+        (ROOT / "TRANSFERBUDGET.json").read_bytes()
