@@ -7,12 +7,13 @@ SHELL := /bin/bash   # tier1 uses pipefail/PIPESTATUS
 .PHONY: check lint lint-fast opbudget-check shardbudget-check \
         metrics-smoke forensics-smoke \
         perf-smoke chaos-smoke adversary-smoke meshwatch-smoke \
-        elastic-smoke trace-smoke pipeline-smoke tier1 core clean
+        elastic-smoke trace-smoke pipeline-smoke skew-smoke tier1 \
+        core clean
 
 check: lint opbudget-check shardbudget-check metrics-smoke \
         forensics-smoke perf-smoke \
         chaos-smoke adversary-smoke meshwatch-smoke elastic-smoke \
-        trace-smoke pipeline-smoke tier1
+        trace-smoke pipeline-smoke skew-smoke tier1
 
 # chainlint: binding contract, header layout, JAX purity, sanitizer
 # matrix, thread races (CONC), SPMD collectives, hot-path blocking,
@@ -180,6 +181,18 @@ pipeline-smoke:
 	    pipeline-smoke 2>/dev/null || \
 	    { echo "pipeline-smoke: failed"; exit 1; }; \
 	echo "pipeline-smoke: ok"
+
+# Skew smoke: the meshprof gate — two same-seed 4-rank --elastic cpu
+# worlds must join the identical (site, round, rank) skew shape (the
+# structural half of the mesh-skew report is deterministic; the
+# millisecond values are scheduler weather), and the report's
+# max_skew_ms must pass the collective_skew SECTION_BOUNDS budget
+# through the perfwatch detector (docs/observability.md §meshprof).
+skew-smoke:
+	env JAX_PLATFORMS=cpu $(PY) -m mpi_blockchain_tpu.meshwatch \
+	    skew-smoke 2>/dev/null || \
+	    { echo "skew-smoke: failed"; exit 1; }; \
+	echo "skew-smoke: ok"
 
 # Perfwatch smoke: serve a faulted instrumented run, scrape /metrics +
 # /healthz live, then prove the regression sentinel flags an injected

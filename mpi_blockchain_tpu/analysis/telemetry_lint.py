@@ -43,6 +43,16 @@ a future edit that emits a bus event through the raw JSON-lines stream
           drift bug that silently hollows out ``perfwatch
           critical-path`` (docs/observability.md §blocktrace).
 
+  TEL005  a rendezvous skew-span emit point (``skew_span(...)``) that
+          does not carry a ``site=`` keyword. The mesh-skew analyzer
+          joins spans ACROSS RANKS on (site, round) — a span born
+          without its site label lands in the shard as unjoinable
+          noise, silently hollowing out ``perfwatch mesh-skew`` the
+          same way a height-less dispatch hollows the critical path
+          (docs/observability.md §meshprof). The runtime spells the
+          parameter keyword-only for exactly this reason; the lint
+          catches the drift where a future refactor loosens it.
+
 Scope: TEL001 over ``mpi_blockchain_tpu/simulation.py`` (the bus
 surface; override key ``sim_py``); TEL002 over every ``.py`` in the
 package (override key ``telemetry_files`` — the drift-fixture seam);
@@ -52,7 +62,9 @@ TEL003 over the multi-rank surfaces — ``parallel/``, ``meshwatch/``,
 override key ``rank_scope_files``); TEL004 over the miner/fused/elastic
 mining loop plus the CLI seam — ``models/miner.py``, ``models/fused.py``,
 ``resilience/elastic.py``, ``cli.py`` (override key
-``blocktrace_scope_files``).
+``blocktrace_scope_files``); TEL005 over the skew-span emit surface —
+``meshprof/``, ``resilience/elastic.py``, ``parallel/mesh.py``,
+``blocktrace/overhead.py`` (override key ``skew_scope_files``).
 """
 from __future__ import annotations
 
@@ -236,6 +248,57 @@ def _run_blocktrace_lint(root: pathlib.Path, files) -> list[Finding]:
     return findings
 
 
+def _skew_scope_files(root: pathlib.Path) -> list[pathlib.Path]:
+    """TEL005's surface: everywhere a rendezvous skew span is born
+    (missing files are skipped, matching the other scope builders)."""
+    pkg = root / "mpi_blockchain_tpu"
+    files = [p for p in (pkg / "resilience" / "elastic.py",
+                         pkg / "parallel" / "mesh.py",
+                         pkg / "blocktrace" / "overhead.py")
+             if p.is_file()]
+    d = pkg / "meshprof"
+    if d.is_dir():
+        files.extend(p for p in d.rglob("*.py")
+                     if "__pycache__" not in p.parts)
+    return sorted(files)
+
+
+def _run_skew_span_lint(root: pathlib.Path, files) -> list[Finding]:
+    """TEL005: every ``skew_span(...)`` emit point carries a literal
+    ``site=`` keyword (a ``**`` spread is opaque and passes — the call
+    site owns it, same stance as TEL004's height)."""
+    findings: list[Finding] = []
+    for path in files:
+        rel = rel_path(path, root)
+        try:
+            tree = ast.parse(path.read_text(), filename=str(path))
+        except SyntaxError as e:
+            findings.append(Finding(rel, e.lineno or 1, "TEL000",
+                                    f"syntax error: {e.msg}"))
+            continue
+        except OSError:
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _call_name(node)
+            # Suffix match for aliased imports (`from ... import
+            # skew_span as _skew_span`), same stance as the profiler
+            # dispatch idiom.
+            if not (name and name.endswith("skew_span")):
+                continue
+            if not any(kw.arg in ("site", None) for kw in node.keywords):
+                findings.append(Finding(
+                    rel, node.lineno, "TEL005",
+                    "skew_span() without site= — the span carries no "
+                    "collective-site label, so the mesh-skew analyzer "
+                    "cannot join it across ranks on (site, round) and "
+                    "it lands in the shard as unjoinable noise; pass "
+                    "site=... at the emit point — "
+                    "docs/observability.md §meshprof"))
+    return findings
+
+
 def _run_rank_label_lint(root: pathlib.Path, files) -> list[Finding]:
     """TEL003: no hand-rolled ``rank=`` label on a raw registry call in
     multi-rank code."""
@@ -279,6 +342,9 @@ def run_telemetry_lint(root: pathlib.Path, overrides=None,
     bt_files = override_files(overrides, "blocktrace_scope_files",
                               lambda: _blocktrace_scope_files(root))
     findings.extend(_run_blocktrace_lint(root, bt_files))
+    skew_files = override_files(overrides, "skew_scope_files",
+                                lambda: _skew_scope_files(root))
+    findings.extend(_run_skew_span_lint(root, skew_files))
     sim_py = overrides.get(
         "sim_py", root / "mpi_blockchain_tpu" / "simulation.py")
     rel = rel_path(sim_py, root)

@@ -8,6 +8,12 @@ Flow events pair by (cat, id); one flow per block (id = the height) with
 a start (``ph: s``) on the first run, steps (``ph: t``) on each middle
 run, and a finish (``ph: f``, ``bp: e``) on the last — each bound to its
 run's slice by landing inside it.
+
+When per-rank ``skew_spans`` (meshprof) are passed, a **collective
+rendezvous** process row is added: one thread per rank, one slice per
+span, named by its collective site — laid on the same wall axis as the
+pipeline rows, the staircase of enters at one (site, round) IS the skew
+the analyzer prices.
 """
 from __future__ import annotations
 
@@ -15,14 +21,58 @@ from ..meshwatch.pipeline import to_chrome_trace
 
 #: The critical-path row's pid — far above any real rank.
 CRITICAL_PID = 999999
+#: The collective-rendezvous row's pid — just under the critical path.
+COLLECTIVE_PID = 999998
 
 
-def to_critical_path_trace(report: dict, records: list[dict]) -> dict:
+def _collective_lane(events: list, skew_spans: dict, epoch: float) -> None:
+    """Append the collective-rendezvous process row: tid = rank, one
+    ``ph: X`` slice per span (name = site; args carry the join key)."""
+    events.append({"ph": "M", "name": "process_name",
+                   "pid": COLLECTIVE_PID, "tid": 0,
+                   "args": {"name": "collective rendezvous"}})
+    for rank in sorted(skew_spans, key=int):
+        events.append({"ph": "M", "name": "thread_name",
+                       "pid": COLLECTIVE_PID, "tid": int(rank),
+                       "args": {"name": f"rank {rank}"}})
+        for rec in skew_spans[rank]:
+            try:
+                ts = (float(rec["t_enter"]) - epoch) * 1e6
+                dur = (float(rec["t_exit"]) - float(rec["t_enter"])) * 1e6
+                site = str(rec["site"])
+            except (KeyError, TypeError, ValueError):
+                continue
+            args = {"site": site, "round": rec.get("round"),
+                    "ok": rec.get("ok", True)}
+            if rec.get("height") is not None:
+                args["height"] = rec["height"]
+            events.append({
+                "ph": "X", "cat": "collective", "name": site,
+                "pid": COLLECTIVE_PID, "tid": int(rank),
+                "ts": round(ts, 3), "dur": round(max(dur, 1e-1), 3),
+                "args": args,
+            })
+
+
+def to_critical_path_trace(report: dict, records: list[dict],
+                           skew_spans: dict | None = None) -> dict:
     """Chrome trace-event JSON: base pipeline rows + the critical-path
-    row. Deterministic for a deterministic (report, records) pair."""
+    row (+ the collective lane when per-rank ``skew_spans`` — a mapping
+    rank -> span list, as carried by meshwatch shards — are passed).
+    Deterministic for a deterministic (report, records) pair."""
     trace = to_chrome_trace(records)
     events = trace["traceEvents"]
     epoch = trace.get("metadata", {}).get("epoch_unix_s")
+    if skew_spans:
+        enters = [float(r["t_enter"]) for spans in skew_spans.values()
+                  for r in spans if r.get("t_enter") is not None]
+        if enters:
+            # Spans share the pipeline's wall-anchored axis; with no
+            # pipeline segments at all, the earliest enter is the epoch.
+            lane_epoch = epoch if epoch is not None else min(enters)
+            _collective_lane(events, skew_spans, lane_epoch)
+            trace.setdefault("metadata", {}).setdefault(
+                "epoch_unix_s", lane_epoch)
     if epoch is None:       # no segments at all: nothing to highlight
         return trace
     events.append({"ph": "M", "name": "process_name", "pid": CRITICAL_PID,

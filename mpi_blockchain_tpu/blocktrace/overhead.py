@@ -46,6 +46,7 @@ import statistics
 import time
 
 from .. import core
+from ..meshprof.spans import skew_span
 from ..telemetry import counter, heartbeat, set_telemetry_disabled
 from ..telemetry.spans import span
 from .context import trace_block
@@ -79,6 +80,11 @@ def _instrumented_round(profiler, height: int, base: int, chunk: int):
                 help="nonces evaluated across all sweeps",
                 backend="trace-audit").inc(chunk)
         heartbeat("bench_heartbeat").inc()
+        # The meshprof rendezvous span: the newest per-round emit point
+        # (ring append + round counter + trace stamp), priced by the
+        # same paired audit — the off half pays only its flag check.
+        with skew_span(site="trace-audit"):
+            pass
     return prec
 
 

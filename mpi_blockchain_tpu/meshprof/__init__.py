@@ -1,0 +1,42 @@
+"""meshprof: collective-skew, straggler & device-memory observability.
+
+The lens ROADMAP item 1 (the live v5e-8 scale-out) needs on day one:
+the quantity that governs multi-chip efficiency is per-rendezvous
+ARRIVAL SKEW at the collective boundary (the one-psum-one-pmin
+``winner_select`` contract pinned in SHARDBUDGET.json) — the chips that
+arrive early idle until the straggler shows up, and nothing else in the
+stack measured that wait. Three pieces (docs/observability.md
+§meshprof):
+
+* **spans** — every rank stamps monotonic enter/exit times per
+  collective site (``skew_span(site=...)``, the TEL005-linted emit
+  idiom) into a bounded ring its meshwatch shard carries. Instrumented
+  seams: ``resilience.elastic.guarded_collective`` (every guarded
+  rendezvous, by its real site label), the ``parallel.mesh`` sharded
+  sweep dispatch, and the elastic world's per-block lockstep
+  supervision step — the rendezvous-equivalent a process-per-rank cpu
+  world joins on.
+* **analyzer** — joins per-rank shards into per-(site, round)
+  arrival-delta distributions, names the straggler rank, its lag, and
+  the implied idle chip-time; per-rank clock offsets are normalized
+  out first so differing monotonic bases cannot fabricate skew.
+  ``publish_skew`` mirrors a report onto the live registry
+  (``collective_skew_ms{site}`` histogram + ``mesh_straggler_rank``
+  gauge).
+* **memory** — per-device HBM/byte watermarks sampled at dispatch
+  boundaries (``jax`` ``memory_stats()`` where available, a zero-cost
+  no-op elsewhere: jax is never imported by this package), surfaced in
+  the shards, ``/healthz``, and the perfwatch ``memory`` axis.
+
+Standard library only — importing this package never pulls in jax
+(the telemetry-package contract), and every emit point is a strict
+no-op under ``MPIBT_TELEMETRY_OFF`` (the blocktrace overhead self-audit
+prices the live emit points; the off leg must cost nothing).
+"""
+from __future__ import annotations
+
+from .analyzer import (analyze_skew, publish_skew,  # noqa: F401
+                       skew_shape, skew_summary)
+from .memory import (device_memory_stats, memory_snapshot,  # noqa: F401
+                     sample_memory)
+from .spans import clear_spans, skew_span, spans_tail  # noqa: F401

@@ -1,0 +1,129 @@
+"""Per-device memory telemetry: HBM/byte watermarks at dispatch edges.
+
+``sample_memory()`` is called from the pipeline profiler's dispatch
+boundary — the one per-sweep host touchpoint the overhead self-audit
+already prices — and throttled to at most one real sample per
+``SAMPLE_INTERVAL_S`` so a hot mining loop pays a clock read, not a
+device query. ``device_memory_stats()`` reads ``jax``'s per-device
+``memory_stats()`` where a backend provides it (TPU does; cpu devices
+usually return None) and — the hard contract — NEVER imports jax: if
+``jax`` is not already in ``sys.modules`` the whole module is a
+zero-cost no-op, so the resilience/telemetry packages stay importable
+on a bare coordinator host.
+
+Watermarks: for each device the peak observed ``bytes_in_use`` (and
+``peak_bytes_in_use`` where the allocator reports it) is kept across
+samples, because the interesting OOM precursor is the high-water mark
+between scrapes, not the instantaneous value the scrape happens to see.
+``memory_snapshot()`` is the shard/healthz projection and force-samples
+first so a freshly started rank is never empty-handed.
+"""
+from __future__ import annotations
+
+import sys
+import threading
+import time
+
+from ..telemetry.registry import telemetry_disabled
+
+#: Minimum seconds between real device queries from the hot path.
+SAMPLE_INTERVAL_S = 0.5
+
+#: memory_stats() keys worth carrying when present (allocator-dependent).
+_STAT_KEYS = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit",
+              "largest_alloc_size", "num_allocs")
+
+_lock = threading.Lock()
+_last_sample = 0.0
+_watermarks: dict[str, dict] = {}
+
+
+def device_memory_stats() -> dict:
+    """{device: memory_stats subset} for every jax device that reports
+    stats. Empty dict when jax was never imported (the gate is
+    ``sys.modules`` membership — this module must not be the reason a
+    process loads jax), when no backend has been initialized yet, or
+    when no backend provides ``memory_stats``."""
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return {}
+    # Only READ devices from an already-initialized backend:
+    # jax.devices() on a cold process would initialize one, which both
+    # costs seconds and — fatally — breaks a later
+    # jax.distributed.initialize() (the multiprocess mesh launch arms
+    # the shard flusher, hence this sampler, BEFORE joining the world).
+    # The probe must not IMPORT anything either: this runs on the
+    # flusher thread, and importing jax._src.xla_bridge while the main
+    # thread is mid-`import jax` leaves the bridge module partially
+    # initialized under jax's own feet (per-module import locks don't
+    # serialize the two entry points). sys.modules lookups only.
+    xla_bridge = sys.modules.get("jax._src.xla_bridge")
+    if not getattr(xla_bridge, "_backends", None):
+        return {}
+    try:
+        devices = jax.devices()
+    except (AttributeError, RuntimeError, ValueError):
+        return {}
+    out: dict[str, dict] = {}
+    for d in devices:
+        try:
+            stats = d.memory_stats()
+        except (AttributeError, RuntimeError, TypeError,
+                ValueError, NotImplementedError):
+            continue
+        if not stats:
+            continue
+        picked = {k: int(stats[k]) for k in _STAT_KEYS
+                  if isinstance(stats.get(k), (int, float))}
+        if picked:
+            out[str(d)] = picked
+    return out
+
+
+def sample_memory(*, force: bool = False) -> dict:
+    """Throttled watermark update from the dispatch hot path. Returns
+    the current watermark map (shared reference is never exposed —
+    callers get the module view via ``memory_snapshot``)."""
+    global _last_sample
+    if telemetry_disabled():
+        return {}
+    now = time.monotonic()
+    with _lock:
+        if not force and now - _last_sample < SAMPLE_INTERVAL_S:
+            return _watermarks
+        _last_sample = now
+    stats = device_memory_stats()
+    if not stats:
+        return _watermarks
+    with _lock:
+        for dev, cur in stats.items():
+            mark = _watermarks.setdefault(dev, {})
+            for k, v in cur.items():
+                if k in ("bytes_in_use", "peak_bytes_in_use",
+                         "largest_alloc_size"):
+                    mark[k] = max(mark.get(k, 0), v)
+                else:
+                    mark[k] = v
+            mark["last_bytes_in_use"] = cur.get("bytes_in_use",
+                                                mark.get("last_bytes_in_use", 0))
+    return _watermarks
+
+
+def memory_snapshot() -> dict:
+    """Copy of the per-device watermarks for the shard writer /
+    ``/healthz`` (force-samples so a new rank reports on first flush).
+    Empty dict where jax is absent — the schema key is always present,
+    its value just stays ``{}`` off-accelerator."""
+    if telemetry_disabled():
+        return {}
+    sample_memory(force=True)
+    with _lock:
+        return {dev: dict(mark) for dev, mark in sorted(_watermarks.items())}
+
+
+def clear_memory() -> None:
+    """Reset watermarks and the throttle (test isolation)."""
+    global _last_sample
+    with _lock:
+        _watermarks.clear()
+        _last_sample = 0.0

@@ -1,0 +1,120 @@
+"""Rendezvous skew spans: per-site monotonic enter/exit stamps.
+
+Every rank wraps its collective-boundary waits in ``skew_span(site=...)``
+(``site`` is keyword-only — chainlint TEL005 enforces the label at every
+emit site, because a span without one cannot be joined across ranks).
+Each span records:
+
+* ``site``  — the collective site label (``winner_select``,
+  ``mesh.build``, ``mesh.rebuild``, ``mesh.sweep``, ``block.step``);
+* ``round`` — a per-site monotonically increasing local index, assigned
+  at ENTER. Every rank passes the same sites in the same order (the
+  SPMD lockstep contract SPMD001-004 protect), so (site, round) is the
+  cross-rank join key the analyzer aligns arrivals on;
+* ``t_enter`` / ``t_exit`` — wall-anchored monotonic floats (one anchor
+  per process, the ``meshwatch.pipeline`` convention): monotonic within
+  a process, wall-comparable across same-host ranks. Cross-process
+  anchors still differ by a small constant; the analyzer estimates and
+  subtracts that per-rank offset, so a clock base can never read as
+  skew (docs/observability.md §meshprof);
+* ``height`` / ``template`` — stamped from the in-scope
+  ``blocktrace.trace_block`` frame, so skew joins to blocks;
+* ``ok`` — False when the wait raised (a timed-out rendezvous is
+  exactly the overhang worth seeing).
+
+Spans land in a bounded process-global ring the meshwatch shard writer
+carries a tail of (``skew_spans``). Standard library only; strict no-op
+under ``MPIBT_TELEMETRY_OFF`` (the overhead-audit contract).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+from ..telemetry.registry import telemetry_disabled
+
+#: Ring capacity — same order as the pipeline profiler's record ring.
+SKEW_RING_SIZE = 4096
+#: Newest spans carried per meshwatch shard write.
+SKEW_TAIL_N = 256
+
+# One anchor per process: time.time() sampled once against perf_counter,
+# so stamps are monotonic (perf_counter) yet wall-scaled (the same
+# convention as PipelineProfiler._anchor — the two timelines must lay
+# on one Perfetto axis).
+_ANCHOR = time.time() - time.perf_counter()
+
+
+def wall_now() -> float:
+    """Wall-anchored monotonic now — the span timestamp base."""
+    return _ANCHOR + time.perf_counter()
+
+
+_lock = threading.Lock()
+_ring: deque = deque(maxlen=SKEW_RING_SIZE)
+_rounds: dict[str, int] = {}
+
+
+class skew_span:
+    """``with skew_span(site="winner_select"): <rendezvous wait>`` —
+    the ONE skew-span emit idiom (chainlint TEL005: the ``site=``
+    keyword is mandatory, and keyword-only here so the runtime agrees
+    with the lint). Records nothing under ``MPIBT_TELEMETRY_OFF``."""
+
+    __slots__ = ("site", "_round", "_t0", "_armed")
+
+    def __init__(self, *, site: str):
+        self.site = str(site)
+        self._armed = not telemetry_disabled()
+        self._round = 0
+        self._t0 = 0.0
+
+    def __enter__(self):
+        if not self._armed:
+            return self
+        # Round index assigned at ENTER: two ranks inside the same
+        # rendezvous agree on the round even if their exits interleave.
+        with _lock:
+            n = _rounds.get(self.site, 0)
+            _rounds[self.site] = n + 1
+        self._round = n
+        self._t0 = wall_now()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if not self._armed:
+            return False
+        t1 = wall_now()
+        rec = {"site": self.site, "round": self._round,
+               "t_enter": self._t0, "t_exit": t1,
+               "ok": exc_type is None}
+        # Late import: blocktrace.context is stdlib-only but importing
+        # it at module load would make the spans module heavier than
+        # the resilience package (which must stay jax-free AND lean).
+        from ..blocktrace.context import current_trace
+
+        trace = current_trace()
+        if trace is not None:
+            rec["height"] = trace.height
+            if trace.template:
+                rec["template"] = trace.template
+        with _lock:
+            _ring.append(rec)
+        return False
+
+
+def spans_tail(n: int = SKEW_TAIL_N) -> list[dict]:
+    """Copies of the newest ``n`` spans (the shard writer's carriage;
+    copies because the flusher json-serializes concurrently)."""
+    with _lock:
+        recs = list(_ring)[-n:] if n is not None else list(_ring)
+    return [dict(r) for r in recs]
+
+
+def clear_spans() -> None:
+    """Empty the ring and reset every site's round counter (test/CLI
+    isolation — a fresh measurement must join rounds from zero)."""
+    with _lock:
+        _ring.clear()
+        _rounds.clear()

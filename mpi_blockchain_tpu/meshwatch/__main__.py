@@ -1,5 +1,5 @@
 """CLI: python -m mpi_blockchain_tpu.meshwatch
-        {merge,report,watch,smoke,bubble,pipeline-smoke}
+        {merge,report,watch,smoke,bubble,pipeline-smoke,skew-smoke}
 
     # one mesh-wide view of a shard directory (counters summed,
     # gauges/histograms per-rank), with rank liveness
@@ -23,6 +23,12 @@ the fixed-seed instrumented mine's pipelined ``bubble_fraction`` stays
 inside the SECTION_BOUNDS budget (<= 0.15), the pipelined chain is
 byte-identical to the sequential oracle, and ``device`` dominates every
 block's critical path; ``bubble`` prints the raw measurement payload.
+
+``skew-smoke`` is the meshprof gate (``make skew-smoke``): two same-seed
+4-rank ``--elastic`` cpu worlds must join the SAME (site, round, rank)
+skew shape (the structural half of the report is deterministic; the
+millisecond values are weather), and the report's ``max_skew_ms`` must
+pass the ``collective_skew`` absolute budget.
 """
 from __future__ import annotations
 
@@ -110,7 +116,7 @@ def cmd_watch(args) -> int:
 
 
 def _spawn_rank(rank: int, world: int, obs_dir: str, difficulty: int,
-                blocks: int):
+                blocks: int, extra: tuple = ()):
     import os
     import subprocess
 
@@ -121,7 +127,8 @@ def _spawn_rank(rank: int, world: int, obs_dir: str, difficulty: int,
            "MPIBT_MESH_OBS_INTERVAL": "0.2"}
     argv = [sys.executable, "-m", "mpi_blockchain_tpu", "mine",
             "--backend", "cpu", "--difficulty", str(difficulty),
-            "--blocks", str(blocks), "--mesh-obs", obs_dir]
+            "--blocks", str(blocks), "--mesh-obs", obs_dir,
+            *extra]
     return subprocess.Popen(argv, env=env, stdout=subprocess.PIPE,
                             stderr=subprocess.PIPE, text=True)
 
@@ -322,6 +329,124 @@ def cmd_pipeline_smoke(args) -> int:
     return 0
 
 
+def _skew_world(world: int, blocks: int, difficulty: int) -> list[dict]:
+    """One same-seed ``--elastic`` cpu world: every rank steps the same
+    heights in lockstep (the ``block.step`` skew spans), mines its
+    stripe, writes its shard, exits 0. Returns the final shard set."""
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as tmp:
+        obs = str(pathlib.Path(tmp) / "mesh")
+        procs = [_spawn_rank(r, world, obs, difficulty=difficulty,
+                             blocks=blocks, extra=("--elastic",))
+                 for r in range(world)]
+        try:
+            for p in procs:
+                out, err = p.communicate(timeout=180)
+                if p.returncode != 0:
+                    raise RuntimeError(
+                        f"skew-smoke rank failed rc={p.returncode}: "
+                        f"{err[-800:]}")
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                    p.wait()
+        return read_shards(obs)
+
+
+def cmd_skew_smoke(args) -> int:
+    """The make skew-smoke gate (meshprof):
+
+    1. **determinism** — two same-seed 4-rank elastic cpu worlds
+       produce mesh-skew reports with the byte-identical STRUCTURAL
+       shape (world, per-site rounds x ranks: the (site, round) join is
+       deterministic; the millisecond values are scheduler weather and
+       deliberately excluded), and re-analyzing one shard set twice is
+       byte-identical (``analyze_skew`` is a pure function). A
+       determinism failure fails outright — never retried;
+    2. **bound** — the report's ``max_skew_ms`` passes the
+       ``collective_skew`` SECTION_BOUNDS budget through the perfwatch
+       detector, best-of-<=3 (clock offsets are normalized out, so a
+       failure means a rank stalled SECONDS inside the lockstep step,
+       not that the processes started staggered).
+    """
+    import json as _json
+
+    from ..meshprof.analyzer import analyze_skew, skew_shape
+    from ..perfwatch.detector import check_candidate
+    from ..perfwatch.history import DEFAULT_HISTORY_NAME, HistoryStore
+
+    world, blocks, difficulty = 4, 8, 8
+    repo_root = pathlib.Path(__file__).resolve().parent.parent.parent
+    store = HistoryStore(repo_root / DEFAULT_HISTORY_NAME)
+    try:
+        shard_runs = [_skew_world(world, blocks, difficulty)
+                      for _ in range(2)]
+    except RuntimeError as e:
+        print(f"skew-smoke: {e}", file=sys.stderr)
+        return 1
+    reports = [analyze_skew(s) for s in shard_runs]
+
+    # 1a. pure re-analysis: same shards -> byte-identical report.
+    if _json.dumps(analyze_skew(shard_runs[0]), sort_keys=True) != \
+            _json.dumps(reports[0], sort_keys=True):
+        print("skew-smoke: analyze_skew is not deterministic over the "
+              "same shards", file=sys.stderr)
+        return 1
+    # 1b. cross-run structural determinism.
+    shapes = [_json.dumps(skew_shape(r), sort_keys=True)
+              for r in reports]
+    if shapes[0] != shapes[1]:
+        print(f"skew-smoke: same-seed runs joined different shapes:\n"
+              f"  {shapes[0]}\n  {shapes[1]}", file=sys.stderr)
+        return 1
+    step = reports[0]["sites"].get("block.step")
+    if (step is None or step["ranks"] != list(range(world))
+            or step["rounds"] < blocks or reports[0]["straggler_rank"] < 0):
+        print(f"skew-smoke: block.step did not join all {world} ranks "
+              f"x {blocks} rounds: {skew_shape(reports[0])}",
+              file=sys.stderr)
+        return 1
+
+    # 2. bound gate, best-of-<=3 (the first two runs count as reads).
+    report = None
+    for attempt, rep in enumerate(reports + [None]):
+        if rep is None:
+            try:
+                rep = analyze_skew(_skew_world(world, blocks, difficulty))
+            except RuntimeError as e:
+                print(f"skew-smoke: {e}", file=sys.stderr)
+                return 1
+        report = rep
+        payload = {"max_skew_ms": rep["max_skew_ms"],
+                   "straggler_rank": rep["straggler_rank"],
+                   "backend": "cpu", "mesh": f"elastic{world}",
+                   "n_blocks": blocks, "world": world}
+        finding = check_candidate(store, "collective_skew", payload)
+        if finding.verdict != "regression":
+            break
+        print(f"skew-smoke: read {attempt + 1} dirty "
+              f"(max_skew_ms {rep['max_skew_ms']})", file=sys.stderr)
+    if finding.verdict == "regression":
+        print(f"skew-smoke: skew over budget: {finding.render()}",
+              file=sys.stderr)
+        return 1
+    step = report["sites"]["block.step"]
+    print(json.dumps({
+        "event": "skew_smoke", "ok": True,
+        "world": world, "blocks": blocks,
+        "site": "block.step",
+        "rounds": step["rounds"],
+        "straggler_rank": step["straggler_rank"],
+        "straggler_lag_ms": step["straggler_lag_ms"],
+        "max_skew_ms": report["max_skew_ms"],
+        "idle_chip_ms": step["idle_chip_ms"],
+        "verdict": finding.verdict,
+    }, sort_keys=True))
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m mpi_blockchain_tpu.meshwatch",
@@ -380,6 +505,12 @@ def main(argv: list[str] | None = None) -> int:
                                 "budget + oracle-identical chain + "
                                 "device-dominant blocks")
     p_psm.set_defaults(fn=cmd_pipeline_smoke)
+
+    p_ssm = sub.add_parser("skew-smoke",
+                           help="the make skew-smoke gate: deterministic "
+                                "4-rank mesh-skew join + the "
+                                "collective_skew absolute budget")
+    p_ssm.set_defaults(fn=cmd_skew_smoke)
 
     args = parser.parse_args(argv)
     try:

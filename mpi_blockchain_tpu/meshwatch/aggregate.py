@@ -266,7 +266,8 @@ def mesh_health(directory, stall_s: float | None = None,
                      "directory": str(directory), "ranks": {},
                      "stale_ranks": [], "failed_ranks": [],
                      "missing_ranks": [],
-                     "live_ranks": 0, "world_size": 0}
+                     "live_ranks": 0, "world_size": 0,
+                     "skew": {}, "memory": {}}
     status = rank_status(shards, stall_s=stall_s, now=now,
                          heartbeat_stall_s=heartbeat_stall_s)
     ranks = status["ranks"]
@@ -301,6 +302,13 @@ def mesh_health(directory, stall_s: float | None = None,
         _stale_announced.discard((dir_key, f"stale:{rank}"))  # recovered
         _stale_announced.discard((dir_key, f"failed:{rank}"))
     healthy = not stale and not failed and not missing
+    # The meshprof joins: live-rank rendezvous skew (straggler named per
+    # site) and per-rank device-memory watermarks. Additive keys — every
+    # pre-existing field keeps its shape (the /healthz schema pin).
+    from ..meshprof.analyzer import analyze_skew, skew_summary
+
+    memory = {str(s.get("rank")): s["memory"] for s in shards
+              if isinstance(s.get("memory"), dict) and s.get("memory")}
     payload = {
         "status": "ok" if healthy else "degraded",
         "healthy": healthy,
@@ -312,6 +320,8 @@ def mesh_health(directory, stall_s: float | None = None,
         "failed_ranks": failed,
         "missing_ranks": missing,
         "ranks": ranks,
+        "skew": skew_summary(analyze_skew(shards)),
+        "memory": memory,
     }
     return (200 if healthy else 503), payload
 

@@ -179,6 +179,13 @@ class PipelineProfiler:
         from the trace context when the call site passed none."""
         if telemetry_disabled():
             return _NULL_RECORD
+        # Device-memory watermark sample at the dispatch boundary — the
+        # one per-sweep host touchpoint the overhead self-audit already
+        # prices. Throttled inside (a hot loop pays a clock read), and a
+        # pure no-op on processes that never imported jax.
+        from ..meshprof.memory import sample_memory
+
+        sample_memory()
         meta = dict(meta)
         trace = current_trace()
         if trace is not None and meta.get("height") is None:

@@ -24,7 +24,11 @@ seconds (default 1.0) and once more at close. The shard carries:
 * ``events_tail`` / ``causal_tail`` — bounded tails of the event ring
   and of any flight-recorder-registered network's causal logs;
 * ``pipeline`` — the dispatch pipeline profiler's record tail
-  (``meshwatch report --dir`` reads these).
+  (``meshwatch report --dir`` reads these);
+* ``skew_spans`` — the newest rendezvous skew spans (``meshprof``: the
+  mesh-skew analyzer joins them across shards on (site, round));
+* ``memory`` — per-device memory watermarks (empty on ranks that never
+  imported jax).
 
 Wall-clock timestamps are deliberate here (unlike the causal logs):
 staleness is a wall-clock question, and shards never participate in the
@@ -111,6 +115,8 @@ class ShardWriter:
         with self._lock:
             self._seq += 1
             seq = self._seq
+        from ..meshprof.memory import memory_snapshot
+        from ..meshprof.spans import SKEW_TAIL_N, spans_tail
         from .pipeline import profiler
 
         return {
@@ -135,6 +141,12 @@ class ShardWriter:
                 for s, r in recent_with_seq(n=EVENTS_TAIL_N)],
             "causal_tail": self._causal_tails(),
             "pipeline": profiler().records(tail=PIPELINE_TAIL_N),
+            # Rendezvous skew spans + device-memory watermarks (the
+            # meshprof carriage: the mesh-skew analyzer joins the spans
+            # across shards on (site, round); memory stays {} on ranks
+            # that never imported jax).
+            "skew_spans": spans_tail(SKEW_TAIL_N),
+            "memory": memory_snapshot(),
         }
 
     # ---- writing ---------------------------------------------------------

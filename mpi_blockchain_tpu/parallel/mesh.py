@@ -286,4 +286,17 @@ def make_mesh_sweep_fn(mesh: Mesh, batch_size: int, difficulty_bits: int,
         return sharded(extend_midstate(jnp.asarray(midstate, _U32),
                                        jnp.asarray(tail_w, _U32)), base)
 
-    return jax.jit(fn)
+    jfn = jax.jit(fn)
+
+    def instrumented(midstate, tail_w, base):
+        # Host-side skew span around the sharded dispatch (the call,
+        # never the traced body — chainlint JAX006): its enter stamp is
+        # this process's arrival at the round whose epilogue is the
+        # winner-select rendezvous, joinable across hosts on a
+        # multi-process mesh.
+        from ..meshprof.spans import skew_span
+
+        with skew_span(site="mesh.sweep"):
+            return jfn(midstate, tail_w, base)
+
+    return instrumented

@@ -1,5 +1,5 @@
 """CLI: python -m mpi_blockchain_tpu.perfwatch
-{record,check,report,critical-path,serve}
+{record,check,report,critical-path,mesh-skew,serve}
 
 The perf-regression sentinel as a merge gate:
 
@@ -22,6 +22,11 @@ The perf-regression sentinel as a merge gate:
     python -m mpi_blockchain_tpu.perfwatch critical-path \\
         --mesh-dir /tmp/mesh --height 12 --json
 
+    # mesh-wide rendezvous skew: per-(site, round) arrival deltas,
+    # straggler rank, lag, idle chip-time (meshprof)
+    python -m mpi_blockchain_tpu.perfwatch mesh-skew \\
+        --mesh-dir /tmp/mesh --json
+
     # standalone endpoint (mine/sim/bench embed the same server via
     # --serve-metrics PORT); serves until interrupted
     python -m mpi_blockchain_tpu.perfwatch serve --port 0
@@ -38,7 +43,8 @@ import json
 import pathlib
 import sys
 
-from .attribution import attribute_pipeline, attribute_spans, utilization
+from .attribution import (attribute_pipeline, attribute_spans,
+                          memory_axis, utilization)
 from .detector import (DEFAULT_SPREAD_K, DEFAULT_THRESHOLD_PCT,
                        check_candidate, check_history, regressions)
 from .history import (DEFAULT_HISTORY_NAME, HistoryStore,
@@ -191,14 +197,68 @@ def cmd_report(args) -> int:
     # in-process path serves embedded callers. Only a non-empty record
     # set is reported (an empty row would read as "no bubble").
     records = None
+    shards = None
     if args.mesh_dir:
         from ..meshwatch.aggregate import read_shards
-        records = [r for s in read_shards(args.mesh_dir)
-                   for r in s.get("pipeline") or []]
+        shards = read_shards(args.mesh_dir)
+        records = [r for s in shards for r in s.get("pipeline") or []]
     pipeline = attribute_pipeline(records)
     if pipeline["dispatch_count"]:
         report["pipeline"] = pipeline
+    # The memory axis (per-device byte watermarks) rides alongside
+    # utilization — only when some device actually reported (an empty
+    # axis would read as "zero bytes used" instead of "no data").
+    memory = memory_axis(shards)
+    if memory["device_count"]:
+        report["memory"] = memory
     print(json.dumps(report, sort_keys=True))
+    return 0
+
+
+def cmd_mesh_skew(args) -> int:
+    """Mesh-wide rendezvous-skew report (meshprof): joins the skew
+    spans of a --mesh-obs shard directory into per-(site, round)
+    arrival deltas, names the per-site straggler rank, its lag and the
+    implied idle chip-time; publishes the result to the live registry
+    (collective_skew_ms{site} + mesh_straggler_rank)."""
+    from ..meshprof.analyzer import analyze_skew, publish_skew
+    from ..meshwatch.aggregate import read_shards
+
+    shards = read_shards(args.mesh_dir)
+    if not shards:
+        print(f"mesh-skew: no shards under {args.mesh_dir}",
+              file=sys.stderr)
+        return 2
+    report = analyze_skew(shards)
+    publish_skew(report)
+    if args.as_json:
+        print(json.dumps({"event": "perfwatch_mesh_skew",
+                          "source": str(args.mesh_dir), **report},
+                         sort_keys=True))
+    else:
+        print(f"mesh-skew: {len(shards)} shard(s), "
+              f"{report['site_count']} joined site(s), world "
+              f"{report['world']}")
+        for site, v in sorted(report["sites"].items()):
+            d = v["skew_ms"]
+            print(f"  {site}: {v['rounds']} round(s) x "
+                  f"{len(v['ranks'])} rank(s)  skew ms "
+                  f"mean={d['mean']:g} p50={d['p50']:g} "
+                  f"p95={d['p95']:g} max={d['max']:g}")
+            print(f"    straggler rank {v['straggler_rank']} "
+                  f"(+{v['straggler_lag_ms']:g} ms mean lag), idle "
+                  f"chip-time {v['idle_chip_ms']:g} ms")
+            offsets = ", ".join(f"r{rk}={ms:+g}" for rk, ms in
+                                sorted(v["clock_offset_ms"].items(),
+                                       key=lambda t: int(t[0])))
+            print(f"    clock offsets ms (normalized out): {offsets}")
+        if report["site_count"]:
+            print(f"mesh-skew: straggler rank "
+                  f"{report['straggler_rank']}, max skew "
+                  f"{report['max_skew_ms']:g} ms")
+        else:
+            print("mesh-skew: no joinable spans (need >= 2 ranks at "
+                  "one (site, round))")
     return 0
 
 
@@ -208,17 +268,21 @@ def cmd_critical_path(args) -> int:
     profiler for embedded callers) into per-block waterfalls."""
     from ..blocktrace.critical_path import critical_path_report, render_text
 
+    skew_spans: dict = {}
     if args.mesh_dir:
         from ..meshwatch.aggregate import read_shards
-        records = [r for s in read_shards(args.mesh_dir)
-                   for r in s.get("pipeline") or []]
+        shards = read_shards(args.mesh_dir)
+        records = [r for s in shards for r in s.get("pipeline") or []]
+        skew_spans = {str(s["rank"]): s["skew_spans"] for s in shards
+                      if s.get("skew_spans") and s.get("rank") is not None}
     else:
         from ..meshwatch.pipeline import profiler
         records = profiler().records()
     report = critical_path_report(records, height=args.height)
     if args.trace:
         from ..blocktrace.export import to_critical_path_trace
-        trace = to_critical_path_trace(report, records)
+        trace = to_critical_path_trace(report, records,
+                                       skew_spans=skew_spans)
         pathlib.Path(args.trace).write_text(
             json.dumps(trace, sort_keys=True))
     if args.as_json:
@@ -407,6 +471,17 @@ def main(argv: list[str] | None = None) -> int:
                       help="also write a Perfetto trace with the "
                            "critical path as a highlighted flow")
     p_cp.set_defaults(fn=cmd_critical_path)
+
+    p_skw = sub.add_parser(
+        "mesh-skew",
+        help="mesh-wide rendezvous-skew report from a --mesh-obs shard "
+             "directory: per-(site, round) arrival deltas, straggler "
+             "rank, lag, idle chip-time (meshprof)")
+    p_skw.add_argument("--mesh-dir", metavar="DIR", required=True,
+                       help="the --mesh-obs shard directory whose "
+                            "skew_spans to join")
+    p_skw.add_argument("--json", action="store_true", dest="as_json")
+    p_skw.set_defaults(fn=cmd_mesh_skew)
 
     p_srv = sub.add_parser("serve", help="standalone metrics endpoint "
                                          "(until interrupted)")

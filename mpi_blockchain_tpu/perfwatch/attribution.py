@@ -290,3 +290,35 @@ def attribute_pipeline(records: list[dict] | None = None) -> dict:
     from ..meshwatch.pipeline import pipeline_report
 
     return pipeline_report(records)
+
+
+# ---- device-memory attribution -------------------------------------------
+
+
+def memory_axis(shards: list[dict] | None = None) -> dict:
+    """The memory axis alongside ``utilization``: per-device byte
+    watermarks (``meshprof.memory``), folded mesh-wide when shards are
+    passed (the report CLI reads a finished run's ``--mesh-obs`` shards,
+    same as the pipeline axis) or from the in-process snapshot for
+    embedded callers. Empty devices/zero peak off-accelerator — the
+    axis reports "no data" honestly rather than a fabricated zero-usage
+    device."""
+    devices: dict[str, dict] = {}
+    if shards is not None:
+        for s in shards:
+            mem = s.get("memory")
+            if not isinstance(mem, dict):
+                continue
+            rank = s.get("rank")
+            for dev, mark in mem.items():
+                if isinstance(mark, dict):
+                    devices[f"r{rank}/{dev}"] = dict(mark)
+    else:
+        from ..meshprof.memory import memory_snapshot
+
+        devices = memory_snapshot()
+    peak = max((m.get("peak_bytes_in_use", m.get("bytes_in_use", 0))
+                for m in devices.values()), default=0)
+    return {"devices": dict(sorted(devices.items())),
+            "device_count": len(devices),
+            "peak_bytes_in_use": int(peak)}

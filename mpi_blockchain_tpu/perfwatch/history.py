@@ -33,6 +33,11 @@ wall-clock, lower is better):
                     bubble_fraction_sequential, the before number from
                     the same-seed sequential oracle leg, for the
                     before/after record; meshwatch/bubble.py)
+    collective_skew max_skew_ms of the 4-rank cpu-world mesh-skew
+                    report (meshprof.analyzer via `make skew-smoke`) —
+                    absolute SECTION_BOUNDS cap; clock offsets are
+                    normalized out so the number is scheduler jitter,
+                    not process-startup stagger
 
 Seeding: ``seed_from_bench_rounds`` imports the repo's existing
 ``BENCH_r0*.json`` round records (fresh measurements only — ``cached``
@@ -64,6 +69,7 @@ SECTION_METRICS: dict[str, tuple[str, str | None]] = {
     "trace_overhead": ("overhead_pct", None),
     "trace_block_observe": ("block_observe_us", None),
     "pipeline_bubble": ("bubble_fraction", None),
+    "collective_skew": ("max_skew_ms", None),
 }
 
 _KEY_FIELDS = ("preset", "kernel", "mesh", "backend")

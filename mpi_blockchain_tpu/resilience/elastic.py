@@ -155,7 +155,6 @@ def guarded_collective(fn, *, site: str = "winner_select",
     worker.thread.name = f"guarded-{site}"
     out: queue.Queue = queue.Queue(maxsize=1)
     t0 = time.monotonic()
-    worker.inbox.put((fn, out))
     try:
         # The wait is a `collective` pipeline segment on the newest
         # dispatch record (stamped with the in-scope block trace), so
@@ -163,15 +162,22 @@ def guarded_collective(fn, *, site: str = "winner_select",
         # separately from device compute — mesh builds/rebuilds happen
         # outside any device window and would otherwise read as gap.
         # Recorded even when the wait times out: that overhang is
-        # exactly the wait worth seeing.
+        # exactly the wait worth seeing. The skew_span wraps the whole
+        # dispatch (put + wait) so its enter stamp is this rank's
+        # ARRIVAL at the rendezvous — the quantity the mesh-skew
+        # analyzer joins across ranks — and a timeout exits the span
+        # with ok=False before the suspicion is raised.
+        from ..meshprof.spans import skew_span
         from ..meshwatch.pipeline import profiler
 
         # chained=False: the wait runs CONCURRENTLY with whatever the
         # record's open stage is — backdating it to the previous stage
         # boundary (the chained default) would stretch it over the
         # whole device window.
-        with profiler().segment_on_last("collective", chained=False):
-            kind, value = out.get(timeout=timeout_s)
+        with skew_span(site=site):
+            worker.inbox.put((fn, out))
+            with profiler().segment_on_last("collective", chained=False):
+                kind, value = out.get(timeout=timeout_s)
     except queue.Empty:
         elapsed = time.monotonic() - t0
         counter("collective_timeouts_total",
@@ -320,9 +326,17 @@ class ElasticWorld:
         """Once per block, BEFORE the sweep: the deterministic
         ``mesh.rank_death`` fault site first (all ranks step in lockstep
         per height, so a seeded victim choice agrees everywhere), then
-        the wall-clock staleness oracle."""
-        self._check_rank_death(height)
-        self._poll_oracle(height)
+        the wall-clock staleness oracle. The step is a skew span: every
+        rank passes here exactly once per height in the same order, so
+        (``block.step``, round) is the cross-PROCESS join key the
+        mesh-skew analyzer aligns a process-per-rank world on — the
+        rendezvous-equivalent of ``winner_select`` for a world with no
+        in-process collective."""
+        from ..meshprof.spans import skew_span
+
+        with skew_span(site="block.step"):
+            self._check_rank_death(height)
+            self._poll_oracle(height)
 
     def _check_rank_death(self, height: int) -> None:
         from . import injection

@@ -508,6 +508,82 @@ def test_tel004_cli_pass_family(tmp_path):
     assert "TEL004" in proc.stdout
 
 
+# ---- TEL005: site labels at rendezvous skew-span emit points -----------
+
+
+SKEW_EMITS = textwrap.dedent("""\
+    from mpi_blockchain_tpu.meshprof.spans import skew_span
+    from mpi_blockchain_tpu.meshprof.spans import skew_span as _skew_span
+
+
+    def emit(site, kw):
+        with skew_span():                      # no site label
+            pass
+        with _skew_span():                     # aliased import
+            pass
+        with skew_span(site=site):             # labelled
+            pass
+        with skew_span(**kw):                  # opaque spread
+            pass
+    """)
+
+
+def test_tel005_siteless_skew_span_fires(tmp_path):
+    from mpi_blockchain_tpu.analysis.telemetry_lint import run_telemetry_lint
+
+    bad = tmp_path / "skew_emits.py"
+    bad.write_text(SKEW_EMITS)
+    findings = run_telemetry_lint(
+        ROOT, overrides={"skew_scope_files": [bad],
+                         "telemetry_files": []})
+    assert rule_set(findings) == {"TEL005"}
+    assert len(findings) == 2                 # site= and ** pass
+    assert all("unjoinable" in f.message for f in findings)
+
+
+def test_tel005_out_of_scope_file_not_checked(tmp_path):
+    from mpi_blockchain_tpu.analysis.telemetry_lint import run_telemetry_lint
+
+    bad = tmp_path / "skew_emits.py"
+    bad.write_text(SKEW_EMITS)
+    findings = run_telemetry_lint(
+        ROOT, overrides={"skew_scope_files": [],
+                         "telemetry_files": [bad]})
+    assert "TEL005" not in rule_set(findings)
+
+
+def test_tel005_live_tree_clean():
+    """Every live skew-span emit point carries its site label, and the
+    live scope actually covers the emit surfaces."""
+    from mpi_blockchain_tpu.analysis.telemetry_lint import (
+        _skew_scope_files, run_telemetry_lint)
+
+    rels = {str(p.relative_to(ROOT)) for p in _skew_scope_files(ROOT)}
+    for expected in ("mpi_blockchain_tpu/meshprof/spans.py",
+                     "mpi_blockchain_tpu/resilience/elastic.py",
+                     "mpi_blockchain_tpu/parallel/mesh.py",
+                     "mpi_blockchain_tpu/blocktrace/overhead.py"):
+        assert expected in rels, expected
+    findings = [f for f in run_telemetry_lint(ROOT)
+                if f.rule == "TEL005"]
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_tel005_cli_pass_family(tmp_path):
+    from mpi_blockchain_tpu.analysis.__main__ import OVERRIDE_KEYS
+
+    assert "skew_scope_files" in OVERRIDE_KEYS
+    bad = tmp_path / "skew_emits.py"
+    bad.write_text(SKEW_EMITS)
+    proc = subprocess.run(
+        [sys.executable, "-m", "mpi_blockchain_tpu.analysis",
+         "--passes", "telemetry", "--override",
+         f"skew_scope_files={bad}"],
+        cwd=ROOT, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "TEL005" in proc.stdout
+
+
 def test_tel002_cli_pass_family(tmp_path):
     bad = tmp_path / "bad_metrics.py"
     bad.write_text(BAD_METRICS)

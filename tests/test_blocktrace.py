@@ -340,6 +340,49 @@ def test_stage_priority_device_over_collective_over_host():
     assert_conserved(b)
 
 
+def test_skewed_monotonic_clock_bases_neither_invent_nor_hide_gap():
+    """Shards whose monotonic anchors differ by a large constant (the
+    cross-host reality): ranks keep SEPARATE waterfalls — cross-host
+    clocks are not comparable — so shifting one rank's entire time base
+    changes nothing in the report. The skew must neither fabricate a
+    gap on the shifted rank nor hide its real intra-rank gap."""
+    base = 100.0
+    skew = 864000.0                 # rank 1's anchor sits 10 days away
+    def rank_segments(t0):
+        # A real 1 ms gap between device and append, on both ranks.
+        return [seg("device", t0, t0 + 0.010),
+                seg("append", t0 + 0.011, t0 + 0.012)]
+
+    def records(rank1_base):
+        return [rec(rank=0, meta={"height": 1},
+                    segments=rank_segments(base)),
+                rec(rank=1, meta={"height": 1},
+                    segments=rank_segments(rank1_base))]
+
+    plain = critical_path_report(records(base))
+    skewed = critical_path_report(records(base + skew))
+    for report in (plain, skewed):
+        b = report["blocks"]["1"]
+        assert set(b["ranks"]) == {"0", "1"}
+        for wf in b["ranks"].values():
+            # the real gap is reported, exactly once, on every rank
+            assert wf["gap_ms"] == pytest.approx(1.0)
+            assert wf["wall_ms"] == pytest.approx(12.0)
+            assert_conserved(wf)
+        assert_conserved(b)
+    # Identical reports up to the absolute per-rank anchor (`t0`): the
+    # clock base must contribute ZERO skew to any derived number.
+    def strip_anchor(report):
+        clone = json.loads(json.dumps(report))
+        for b in clone["blocks"].values():
+            for wf in b["ranks"].values():
+                wf.pop("t0")
+        return clone
+
+    assert json.dumps(strip_anchor(plain), sort_keys=True) == \
+        json.dumps(strip_anchor(skewed), sort_keys=True)
+
+
 # ---- report shape, determinism, rendering -------------------------------
 
 

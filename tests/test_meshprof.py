@@ -1,0 +1,521 @@
+"""meshprof subsystem tests (mpi_blockchain_tpu/meshprof).
+
+Covers the rendezvous skew spans (per-site round assignment, the
+trace_block height stamp, the telemetry kill switch, ring bounds), the
+mesh-wide skew analyzer (clock-offset normalization, straggler naming,
+idle chip-time, determinism, malformed-shard tolerance), the
+device-memory watermarks (jax-absence no-op, throttling, watermark
+maxing), the mesh ``/healthz`` schema pin with the additive
+``skew``/``memory`` fields, the shard payload carriage, the Perfetto
+collective-rendezvous lane, the perfwatch ``memory`` axis, and the
+``perfwatch mesh-skew`` CLI.
+"""
+import json
+import sys
+
+import pytest
+
+from mpi_blockchain_tpu import telemetry
+from mpi_blockchain_tpu.blocktrace import trace_block
+from mpi_blockchain_tpu.blocktrace.export import (COLLECTIVE_PID,
+                                                  CRITICAL_PID,
+                                                  to_critical_path_trace)
+from mpi_blockchain_tpu.blocktrace.critical_path import critical_path_report
+from mpi_blockchain_tpu.meshprof import (analyze_skew, clear_spans,
+                                         memory_snapshot, publish_skew,
+                                         sample_memory, skew_shape,
+                                         skew_span, skew_summary, spans_tail)
+from mpi_blockchain_tpu.meshprof import memory as memory_mod
+from mpi_blockchain_tpu.meshprof.memory import clear_memory
+from mpi_blockchain_tpu.meshwatch import aggregate
+from mpi_blockchain_tpu.meshwatch.aggregate import mesh_health
+from mpi_blockchain_tpu.meshwatch.pipeline import reset_profiler
+from mpi_blockchain_tpu.meshwatch.shard import ShardWriter, shard_path
+from mpi_blockchain_tpu.telemetry.registry import set_telemetry_disabled
+
+
+@pytest.fixture(autouse=True)
+def fresh_state():
+    telemetry.reset()
+    telemetry.clear_events()
+    telemetry.set_mesh_rank(0)
+    reset_profiler()
+    clear_spans()
+    clear_memory()
+    set_telemetry_disabled(False)
+    aggregate._stale_announced.clear()
+    yield
+    telemetry.reset()
+    telemetry.clear_events()
+    telemetry.set_mesh_rank(0)
+    reset_profiler()
+    clear_spans()
+    clear_memory()
+    set_telemetry_disabled(False)
+    aggregate._stale_announced.clear()
+
+
+def span(site, rnd, t, ok=True, **extra):
+    return {"site": site, "round": rnd, "t_enter": t,
+            "t_exit": t + 0.001, "ok": ok, **extra}
+
+
+def shard(rank, spans=(), memory=None, **extra):
+    s = {"version": 1, "rank": rank, "world_size": 2,
+         "skew_spans": list(spans), **extra}
+    if memory is not None:
+        s["memory"] = memory
+    return s
+
+
+def lockstep_shards(lags_by_rank, site="block.step", offsets=None):
+    """World where every rank joins every round; rank r arrives at
+    round + offset[r] + lags_by_rank[r][round] seconds."""
+    offsets = offsets or {r: 0.0 for r in lags_by_rank}
+    return [shard(r, [span(site, i, 1000.0 + i + offsets[r] + lag)
+                      for i, lag in enumerate(lags)])
+            for r, lags in sorted(lags_by_rank.items())]
+
+
+# ---- skew spans ---------------------------------------------------------
+
+
+def test_span_site_is_keyword_only():
+    with pytest.raises(TypeError):
+        skew_span("block.step")
+
+
+def test_span_rounds_count_per_site_independently():
+    for _ in range(2):
+        with skew_span(site="mesh.sweep"):
+            pass
+    with skew_span(site="block.step"):
+        pass
+    tail = spans_tail()
+    rounds = [(r["site"], r["round"]) for r in tail]
+    assert rounds == [("mesh.sweep", 0), ("mesh.sweep", 1),
+                      ("block.step", 0)]
+    assert all(r["t_exit"] >= r["t_enter"] for r in tail)
+    assert all(r["ok"] for r in tail)
+
+
+def test_span_exception_exits_with_ok_false():
+    with pytest.raises(RuntimeError):
+        with skew_span(site="winner_select"):
+            raise RuntimeError("timeout")
+    (rec,) = spans_tail()
+    assert rec["ok"] is False and rec["site"] == "winner_select"
+
+
+def test_span_stamps_height_from_trace_block():
+    with trace_block(7, template=2):
+        with skew_span(site="block.step"):
+            pass
+    (rec,) = spans_tail()
+    assert rec["height"] == 7 and rec["template"] == 2
+
+
+def test_span_kill_switch_records_nothing():
+    set_telemetry_disabled(True)
+    with skew_span(site="block.step"):
+        pass
+    set_telemetry_disabled(False)
+    assert spans_tail() == []
+    # The round counter did not advance either: a disabled span must
+    # not desynchronize the (site, round) join of a later enabled run.
+    with skew_span(site="block.step"):
+        pass
+    assert spans_tail()[0]["round"] == 0
+
+
+def test_spans_tail_bounded_and_returns_copies():
+    for _ in range(5):
+        with skew_span(site="s"):
+            pass
+    tail = spans_tail(2)
+    assert [r["round"] for r in tail] == [3, 4]
+    tail[0]["site"] = "mutated"
+    assert spans_tail(2)[0]["site"] == "s"
+
+
+def test_clear_spans_resets_rounds():
+    with skew_span(site="s"):
+        pass
+    clear_spans()
+    with skew_span(site="s"):
+        pass
+    assert [r["round"] for r in spans_tail()] == [0]
+
+
+# ---- the analyzer -------------------------------------------------------
+
+
+def test_constant_clock_offset_contributes_zero_skew():
+    """A rank whose anchor sits seconds away must read as a clock
+    offset, never as skew — normalization subtracts it exactly."""
+    shards = lockstep_shards({0: [0.0] * 6, 1: [0.0] * 6, 2: [0.0] * 6},
+                             offsets={0: 0.0, 1: 5.0, 2: -3.0})
+    rep = analyze_skew(shards)
+    site = rep["sites"]["block.step"]
+    assert rep["max_skew_ms"] == 0.0
+    assert site["idle_chip_ms"] == 0.0
+    # ... and the estimated offsets are reported, not hidden.
+    assert abs(float(site["clock_offset_ms"]["1"]) - 5000.0) < 10.0
+    assert abs(float(site["clock_offset_ms"]["2"]) + 3000.0) < 10.0
+
+
+def test_jitter_names_straggler_and_prices_idle():
+    jitter = [0.0, 0.004, 0.0, 0.006, 0.0, 0.005]
+    shards = lockstep_shards({0: [0.0] * 6, 1: jitter, 2: [0.0] * 6},
+                             offsets={0: 0.0, 1: 5.0, 2: -3.0})
+    rep = analyze_skew(shards)
+    site = rep["sites"]["block.step"]
+    assert rep["straggler_rank"] == 1
+    assert site["straggler_rank"] == 1
+    assert site["straggler_lag_ms"] > max(
+        v for k, v in site["per_rank_lag_ms"].items() if k != "1")
+    assert rep["max_skew_ms"] >= 4.0
+    # idle chip time: the two punctual ranks wait out every late round.
+    assert site["idle_chip_ms"] > 0.0
+    assert len(site["round_skews_ms"]) == site["rounds"] == 6
+
+
+def test_straggler_tie_breaks_to_lowest_rank():
+    # Symmetric alternating jitter: ranks 0 and 1 lag identically.
+    shards = lockstep_shards({0: [0.004, 0.0] * 3, 1: [0.0, 0.004] * 3})
+    rep = analyze_skew(shards)
+    assert rep["sites"]["block.step"]["straggler_rank"] == 0
+
+
+def test_single_rank_rounds_are_dropped():
+    shards = [shard(0, [span("s", 0, 1.0), span("s", 1, 2.0)])]
+    rep = analyze_skew(shards)
+    assert rep["site_count"] == 0 and rep["sites"] == {}
+    assert rep["straggler_rank"] == -1 and rep["world"] == []
+
+
+def test_partial_participation_joins_shared_rounds_only():
+    shards = lockstep_shards({0: [0.0] * 4, 1: [0.0] * 4})
+    shards[1]["skew_spans"] = shards[1]["skew_spans"][:2]  # rank 1 died
+    rep = analyze_skew(shards)
+    assert rep["sites"]["block.step"]["rounds"] == 2
+
+
+def test_malformed_spans_and_shards_tolerated():
+    shards = lockstep_shards({0: [0.0] * 3, 1: [0.0] * 3})
+    shards[0]["skew_spans"].extend([
+        "not-a-dict", {"site": None, "round": 0, "t_enter": 1.0},
+        {"site": "s"}, {"site": "s", "round": "x", "t_enter": "y"}])
+    shards.append({"rank": None, "skew_spans": [span("s", 0, 1.0)]})
+    rep = analyze_skew(shards)
+    assert rep["sites"]["block.step"]["rounds"] == 3
+    assert rep["world"] == [0, 1]
+
+
+def test_analyzer_pure_and_shard_order_independent():
+    shards = lockstep_shards({0: [0.0, 0.002, 0.0], 1: [0.001, 0.0, 0.003]})
+    base = json.dumps(analyze_skew(shards), sort_keys=True)
+    assert json.dumps(analyze_skew(shards), sort_keys=True) == base
+    assert json.dumps(analyze_skew(list(reversed(shards))),
+                      sort_keys=True) == base
+
+
+def test_skew_shape_strips_timings():
+    rep = analyze_skew(lockstep_shards({0: [0.0] * 3, 1: [0.001] * 3}))
+    assert skew_shape(rep) == {
+        "world": [0, 1],
+        "sites": {"block.step": {"rounds": 3, "ranks": [0, 1]}}}
+
+
+def test_skew_summary_digest_fields():
+    rep = analyze_skew(lockstep_shards(
+        {0: [0.0, 0.0], 1: [0.002, 0.004]}))
+    summary = skew_summary(rep)
+    assert set(summary) == {"site_count", "straggler_rank",
+                            "max_skew_ms", "sites"}
+    site = summary["sites"]["block.step"]
+    assert set(site) == {"rounds", "straggler_rank", "straggler_lag_ms",
+                         "skew_p95_ms", "idle_chip_ms"}
+
+
+def test_publish_skew_mirrors_onto_registry():
+    rep = analyze_skew(lockstep_shards(
+        {0: [0.0] * 4, 1: [0.002, 0.0, 0.004, 0.0]}))
+    publish_skew(rep)
+    snap = telemetry.default_registry().render_prometheus()
+    # Histograms render as summaries: quantile samples + _count/_sum.
+    assert "collective_skew_ms_count" in snap
+    assert 'site="block.step"' in snap
+    assert 'mesh_straggler_rank{site="block.step"} 1' in snap
+    assert "\nmesh_straggler_rank 1\n" in snap    # the overall gauge
+
+
+def test_publish_skew_noop_under_kill_switch():
+    rep = analyze_skew(lockstep_shards({0: [0.0] * 2, 1: [0.002] * 2}))
+    set_telemetry_disabled(True)
+    publish_skew(rep)
+    set_telemetry_disabled(False)
+    assert "collective_skew_ms" not in \
+        telemetry.default_registry().render_prometheus()
+
+
+# ---- device-memory watermarks -------------------------------------------
+
+
+def test_device_memory_stats_never_imports_jax(monkeypatch):
+    monkeypatch.delitem(sys.modules, "jax", raising=False)
+    from mpi_blockchain_tpu.meshprof.memory import device_memory_stats
+
+    assert device_memory_stats() == {}
+    assert "jax" not in sys.modules
+    assert memory_snapshot() == {}
+
+
+def test_device_memory_stats_cold_backend_is_noop(monkeypatch):
+    """With jax imported but NO backend initialized yet, the sampler
+    must not touch jax.devices(): initializing a backend from the
+    shard flusher would break a later jax.distributed.initialize()
+    (the multiprocess mesh launch arms the flusher before joining)."""
+    jax = pytest.importorskip("jax")
+    from jax._src import xla_bridge
+
+    def boom():
+        raise AssertionError("device_memory_stats initialized a backend")
+
+    monkeypatch.setattr(jax, "devices", boom)
+    monkeypatch.setattr(xla_bridge, "_backends", {})
+    from mpi_blockchain_tpu.meshprof.memory import device_memory_stats
+
+    assert device_memory_stats() == {}
+
+
+def test_sample_memory_watermarks_and_throttle(monkeypatch):
+    calls = []
+
+    def fake_stats():
+        calls.append(1)
+        return {"dev0": {"bytes_in_use": 100 + 50 * len(calls),
+                         "peak_bytes_in_use": 400,
+                         "bytes_limit": 1000}}
+
+    monkeypatch.setattr(memory_mod, "device_memory_stats", fake_stats)
+    sample_memory(force=True)
+    sample_memory()                 # throttled: no device query
+    assert len(calls) == 1
+    snap = memory_snapshot()        # force-samples (second real query)
+    assert len(calls) == 2
+    mark = snap["dev0"]
+    assert mark["bytes_in_use"] == 200          # watermark max
+    assert mark["last_bytes_in_use"] == 200     # instantaneous
+    assert mark["peak_bytes_in_use"] == 400
+    assert mark["bytes_limit"] == 1000
+
+    def shrinking():
+        return {"dev0": {"bytes_in_use": 10, "bytes_limit": 900}}
+
+    monkeypatch.setattr(memory_mod, "device_memory_stats", shrinking)
+    mark = memory_snapshot()["dev0"]
+    assert mark["bytes_in_use"] == 200          # high-water survives
+    assert mark["last_bytes_in_use"] == 10
+    assert mark["bytes_limit"] == 900           # non-watermark overwrites
+
+
+def test_memory_kill_switch(monkeypatch):
+    monkeypatch.setattr(memory_mod, "device_memory_stats",
+                        lambda: {"dev0": {"bytes_in_use": 1}})
+    set_telemetry_disabled(True)
+    assert sample_memory(force=True) == {}
+    assert memory_snapshot() == {}
+
+
+# ---- shard + /healthz carriage (the schema pin) -------------------------
+
+
+def test_shard_payload_carries_skew_spans_and_memory(tmp_path):
+    with skew_span(site="block.step"):
+        pass
+    w = ShardWriter(tmp_path, rank=0, world_size=1)
+    s = json.loads(w.write().read_text())
+    assert s["skew_spans"][0]["site"] == "block.step"
+    assert s["memory"] == {}        # cpu host: present, empty
+
+
+def test_mesh_health_payload_schema_pin():
+    """The /healthz schema: every pre-existing key unchanged, plus the
+    additive meshprof `skew` and `memory` fields."""
+    spans0 = [span("block.step", i, 1000.0 + i) for i in range(3)]
+    spans1 = [span("block.step", i, 1000.0 + i + 0.002 * (i % 2))
+              for i in range(3)]
+    shards = [
+        shard(0, spans0, memory={"dev0": {"bytes_in_use": 7}},
+              world_size=2, final=False, written_at=1e12, pid=1, seq=3,
+              heartbeats={}, registry={}),
+        shard(1, spans1, world_size=2, final=False, written_at=1e12,
+              pid=2, seq=3, heartbeats={}, registry={}),
+    ]
+    code, health = mesh_health("x", stall_s=1e12, now=1e12, shards=shards)
+    assert code == 200
+    assert set(health) == {"status", "healthy", "world_size", "stall_s",
+                           "heartbeat_stall_s", "live_ranks",
+                           "stale_ranks", "failed_ranks", "missing_ranks",
+                           "ranks", "skew", "memory"}
+    assert health["skew"]["sites"]["block.step"]["straggler_rank"] == 1
+    assert health["memory"] == {"0": {"dev0": {"bytes_in_use": 7}}}
+
+
+def test_mesh_health_no_shards_carries_empty_meshprof_fields(tmp_path):
+    code, health = mesh_health(tmp_path / "empty")
+    assert code == 503
+    assert health["skew"] == {} and health["memory"] == {}
+
+
+# ---- Perfetto collective lane -------------------------------------------
+
+
+def _pipeline_records():
+    return [{"dispatch": 0, "rank": 0, "meta": {"height": 1},
+             "segments": [{"stage": "device", "t0": 100.0, "t1": 100.010},
+                          {"stage": "append", "t0": 100.010,
+                           "t1": 100.012}]}]
+
+
+def test_export_collective_lane_rows_and_args():
+    records = _pipeline_records()
+    report = critical_path_report(records)
+    skew_spans = {"0": [span("block.step", 0, 100.001, height=1)],
+                  "1": [span("block.step", 0, 100.004)]}
+    trace = to_critical_path_trace(report, records, skew_spans=skew_spans)
+    lane = [e for e in trace["traceEvents"]
+            if e.get("pid") == COLLECTIVE_PID]
+    names = [e for e in lane if e["ph"] == "M"]
+    assert any(e["name"] == "process_name"
+               and e["args"]["name"] == "collective rendezvous"
+               for e in names)
+    assert {e["args"]["name"] for e in names
+            if e["name"] == "thread_name"} == {"rank 0", "rank 1"}
+    slices = [e for e in lane if e["ph"] == "X"]
+    assert {e["tid"] for e in slices} == {0, 1}
+    assert all(e["cat"] == "collective"
+               and e["name"] == "block.step"
+               and e["args"]["round"] == 0 for e in slices)
+    assert [e["args"].get("height") for e in sorted(slices,
+                                                    key=lambda e: e["tid"])
+            ] == [1, None]
+    # Same wall axis as the pipeline rows: epoch-relative microseconds.
+    epoch = trace["metadata"]["epoch_unix_s"]
+    by_rank = {e["tid"]: e["ts"] for e in slices}
+    assert by_rank[0] == pytest.approx((100.001 - epoch) * 1e6, abs=1.0)
+    assert by_rank[1] - by_rank[0] == pytest.approx(3000.0, abs=1.0)
+
+
+def test_export_lane_without_pipeline_records():
+    """Spans alone (no pipeline segments): the earliest enter anchors
+    the lane; no critical-path row appears."""
+    skew_spans = {"0": [span("mesh.sweep", 0, 500.0)],
+                  "1": [span("mesh.sweep", 0, 500.010)]}
+    trace = to_critical_path_trace(critical_path_report([]), [],
+                                   skew_spans=skew_spans)
+    lane = [e for e in trace["traceEvents"]
+            if e.get("pid") == COLLECTIVE_PID and e["ph"] == "X"]
+    assert len(lane) == 2 and min(e["ts"] for e in lane) == 0.0
+    assert trace["metadata"]["epoch_unix_s"] == 500.0
+    assert all(e.get("pid") != CRITICAL_PID
+               for e in trace["traceEvents"])
+    # Malformed spans are skipped, never crash the export.
+    bad = {"0": [{"round": 0}], "1": []}
+    assert to_critical_path_trace(critical_path_report([]), [],
+                                  skew_spans=bad) is not None
+
+
+# ---- perfwatch memory axis + mesh-skew CLI ------------------------------
+
+
+def test_memory_axis_folds_shard_devices():
+    from mpi_blockchain_tpu.perfwatch.attribution import memory_axis
+
+    shards = [shard(0, memory={"TPU_0": {"bytes_in_use": 10,
+                                         "peak_bytes_in_use": 60}}),
+              shard(1, memory={"TPU_0": {"bytes_in_use": 40}})]
+    axis = memory_axis(shards)
+    assert sorted(axis["devices"]) == ["r0/TPU_0", "r1/TPU_0"]
+    assert axis["device_count"] == 2
+    assert axis["peak_bytes_in_use"] == 60
+
+
+def test_memory_axis_in_process_empty_without_devices():
+    from mpi_blockchain_tpu.perfwatch.attribution import memory_axis
+
+    axis = memory_axis(None)
+    assert axis["device_count"] == len(axis["devices"])
+
+
+def _write_skew_shard(directory, rank, spans):
+    directory.mkdir(parents=True, exist_ok=True)
+    shard_path(directory, rank).write_text(json.dumps(
+        {"version": 1, "rank": rank, "world_size": 2,
+         "skew_spans": spans}))
+
+
+def test_cli_mesh_skew_json_and_text(tmp_path, capsys):
+    from mpi_blockchain_tpu.perfwatch.__main__ import main
+
+    mesh = tmp_path / "mesh"
+    _write_skew_shard(mesh, 0,
+                      [span("block.step", i, 1000.0 + i)
+                       for i in range(3)])
+    _write_skew_shard(mesh, 1,
+                      [span("block.step", i, 1000.0 + i + 0.002 * (i % 2))
+                       for i in range(3)])
+    assert main(["mesh-skew", "--mesh-dir", str(mesh), "--json"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["event"] == "perfwatch_mesh_skew"
+    assert out["sites"]["block.step"]["straggler_rank"] == 1
+    # The report is also mirrored onto the live registry.
+    assert "collective_skew_ms" in \
+        telemetry.default_registry().render_prometheus()
+    assert main(["mesh-skew", "--mesh-dir", str(mesh)]) == 0
+    text = capsys.readouterr().out
+    assert "block.step" in text and "straggler" in text
+
+
+def test_cli_mesh_skew_empty_directory(tmp_path, capsys):
+    from mpi_blockchain_tpu.perfwatch.__main__ import main
+
+    assert main(["mesh-skew", "--mesh-dir", str(tmp_path / "none")]) == 2
+
+
+# ---- the collective_skew bench section ----------------------------------
+
+
+def test_collective_skew_gated_by_absolute_bound(tmp_path):
+    from mpi_blockchain_tpu.perfwatch.detector import check_candidate
+    from mpi_blockchain_tpu.perfwatch.history import HistoryStore
+
+    store = HistoryStore(tmp_path / "hist.jsonl")   # empty: no baseline
+    wedged = check_candidate(store, "collective_skew",
+                             {"max_skew_ms": 60000.0, "backend": "cpu",
+                              "mesh": "elastic4"})
+    assert wedged.verdict == "regression"
+    assert wedged.basis == "absolute-bound"
+    ok = check_candidate(store, "collective_skew",
+                         {"max_skew_ms": 40.0, "backend": "cpu",
+                          "mesh": "elastic4"})
+    assert ok.verdict == "ok"
+
+
+def test_committed_history_collective_skew_within_budget():
+    """The recorded PERF_HISTORY.jsonl skew measurement passes its own
+    gate — the acceptance loop `perfwatch check` runs on every
+    checkout."""
+    import pathlib
+
+    from mpi_blockchain_tpu.perfwatch.detector import check_history
+    from mpi_blockchain_tpu.perfwatch.history import (DEFAULT_HISTORY_NAME,
+                                                      HistoryStore)
+
+    repo = pathlib.Path(__file__).resolve().parent.parent
+    store = HistoryStore(repo / DEFAULT_HISTORY_NAME)
+    mine = [f for f in check_history(store)
+            if f.section == "collective_skew"]
+    assert mine, "no collective_skew entry recorded in PERF_HISTORY.jsonl"
+    assert all(f.verdict == "ok" for f in mine)
