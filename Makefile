@@ -7,13 +7,13 @@ SHELL := /bin/bash   # tier1 uses pipefail/PIPESTATUS
 .PHONY: check lint lint-fast opbudget-check shardbudget-check \
         metrics-smoke forensics-smoke \
         perf-smoke chaos-smoke adversary-smoke meshwatch-smoke \
-        elastic-smoke trace-smoke pipeline-smoke skew-smoke tier1 \
-        core clean
+        elastic-smoke trace-smoke pipeline-smoke skew-smoke \
+        incident-smoke tier1 core clean
 
 check: lint opbudget-check shardbudget-check metrics-smoke \
         forensics-smoke perf-smoke \
         chaos-smoke adversary-smoke meshwatch-smoke elastic-smoke \
-        trace-smoke pipeline-smoke skew-smoke tier1
+        trace-smoke pipeline-smoke skew-smoke incident-smoke tier1
 
 # chainlint: binding contract, header layout, JAX purity, sanitizer
 # matrix, thread races (CONC), SPMD collectives, hot-path blocking,
@@ -201,6 +201,16 @@ perf-smoke:
 	env JAX_PLATFORMS=cpu $(PY) -m mpi_blockchain_tpu.perfwatch smoke \
 	    2>/dev/null || { echo "perf-smoke: failed"; exit 1; }; \
 	echo "perf-smoke: ok"
+
+# Incident smoke: the chainwatch gate — a fault-injected 4-rank cpu
+# world must yield EXACTLY the expected incident (one event_storm on
+# the faulted rank, complete schema-pinned bundle, every rank still
+# exits 0), and a clean fixed-seed world must yield ZERO incidents
+# (the false-positive pin; docs/observability.md §chainwatch).
+incident-smoke:
+	env JAX_PLATFORMS=cpu $(PY) -m mpi_blockchain_tpu.chainwatch smoke \
+	    2>/dev/null || { echo "incident-smoke: failed"; exit 1; }; \
+	echo "incident-smoke: ok"
 
 # Tier-1 verify, verbatim from ROADMAP.md.
 tier1:

@@ -53,6 +53,17 @@ a future edit that emits a bus event through the raw JSON-lines stream
           parameter keyword-only for exactly this reason; the lint
           catches the drift where a future refactor loosens it.
 
+  TEL006  a chainwatch incident emit point (``emit_incident(...)``)
+          that does not carry explicit ``rule=`` and ``severity=``
+          keywords. The incident surfaces all key on them — the
+          ``incidents_total{rule,severity}`` counter, the open-episode
+          table the shards//healthz//incidents views merge on, the
+          bundle filename, the Perfetto annotation lane — so an emit
+          born without them produces an incident the whole triage
+          pipeline cannot classify (the runtime spells both parameters
+          keyword-only; the lint catches the refactor that loosens it,
+          same stance as TEL005's site=).
+
 Scope: TEL001 over ``mpi_blockchain_tpu/simulation.py`` (the bus
 surface; override key ``sim_py``); TEL002 over every ``.py`` in the
 package (override key ``telemetry_files`` — the drift-fixture seam);
@@ -64,7 +75,10 @@ mining loop plus the CLI seam — ``models/miner.py``, ``models/fused.py``,
 ``resilience/elastic.py``, ``cli.py`` (override key
 ``blocktrace_scope_files``); TEL005 over the skew-span emit surface —
 ``meshprof/``, ``resilience/elastic.py``, ``parallel/mesh.py``,
-``blocktrace/overhead.py`` (override key ``skew_scope_files``).
+``blocktrace/overhead.py`` (override key ``skew_scope_files``); TEL006
+over the incident emit surface — ``chainwatch/`` plus the wired seams
+``resilience/elastic.py``, ``blocktrace/critical_path.py``,
+``meshwatch/shard.py`` (override key ``incident_scope_files``).
 """
 from __future__ import annotations
 
@@ -299,6 +313,62 @@ def _run_skew_span_lint(root: pathlib.Path, files) -> list[Finding]:
     return findings
 
 
+def _incident_scope_files(root: pathlib.Path) -> list[pathlib.Path]:
+    """TEL006's surface: everywhere a chainwatch incident is born —
+    the subsystem itself plus the wired seams (missing files are
+    skipped, matching the other scope builders)."""
+    pkg = root / "mpi_blockchain_tpu"
+    files = [p for p in (pkg / "resilience" / "elastic.py",
+                         pkg / "blocktrace" / "critical_path.py",
+                         pkg / "meshwatch" / "shard.py")
+             if p.is_file()]
+    d = pkg / "chainwatch"
+    if d.is_dir():
+        files.extend(p for p in d.rglob("*.py")
+                     if "__pycache__" not in p.parts)
+    return sorted(files)
+
+
+def _run_incident_lint(root: pathlib.Path, files) -> list[Finding]:
+    """TEL006: every ``emit_incident(...)`` emit point carries explicit
+    ``rule=`` and ``severity=`` keywords (a ``**`` spread is opaque and
+    passes — the call site owns it, same stance as TEL005's site)."""
+    findings: list[Finding] = []
+    for path in files:
+        rel = rel_path(path, root)
+        try:
+            tree = ast.parse(path.read_text(), filename=str(path))
+        except SyntaxError as e:
+            findings.append(Finding(rel, e.lineno or 1, "TEL000",
+                                    f"syntax error: {e.msg}"))
+            continue
+        except OSError:
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _call_name(node)
+            # Suffix match for aliased imports (`from ... import
+            # emit_incident as _emit_incident`), same stance as TEL005.
+            if not (name and name.endswith("emit_incident")):
+                continue
+            for req in ("rule", "severity"):
+                if not any(kw.arg in (req, None)
+                           for kw in node.keywords):
+                    findings.append(Finding(
+                        rel, node.lineno, "TEL006",
+                        f"emit_incident() without {req}= — every "
+                        f"incident surface (incidents_total labels, "
+                        f"the open-episode table the shard//healthz/"
+                        f"/incidents views merge on, the bundle "
+                        f"filename, the Perfetto annotation lane) keys "
+                        f"on it, so the triage pipeline cannot "
+                        f"classify the incident; pass {req}=... at the "
+                        f"emit point — docs/observability.md "
+                        f"§chainwatch"))
+    return findings
+
+
 def _run_rank_label_lint(root: pathlib.Path, files) -> list[Finding]:
     """TEL003: no hand-rolled ``rank=`` label on a raw registry call in
     multi-rank code."""
@@ -345,6 +415,9 @@ def run_telemetry_lint(root: pathlib.Path, overrides=None,
     skew_files = override_files(overrides, "skew_scope_files",
                                 lambda: _skew_scope_files(root))
     findings.extend(_run_skew_span_lint(root, skew_files))
+    incident_files = override_files(overrides, "incident_scope_files",
+                                    lambda: _incident_scope_files(root))
+    findings.extend(_run_incident_lint(root, incident_files))
     sim_py = overrides.get(
         "sim_py", root / "mpi_blockchain_tpu" / "simulation.py")
     rel = rel_path(sim_py, root)

@@ -364,6 +364,14 @@ def observe_block_metrics(height: int, records: list[dict] | None = None,
         from ..meshwatch.pipeline import profiler
         records = profiler().records(tail=tail)
     out = observe_batch_metrics([height], records, **labels)
+    # The per-block metrics call is chainwatch's hot-path evaluation
+    # cadence (the other is the shard-flush tick). Throttled inside to
+    # one full rule sweep per MPIBT_CHAINWATCH_INTERVAL; disarmed/off
+    # processes pay a flag check. Priced by the trace_overhead audit
+    # (blocktrace/overhead.py), which calls this same seam per round.
+    from ..chainwatch import evaluate as chainwatch_evaluate
+
+    chainwatch_evaluate(height=int(height), source="block")
     return out.get(int(height))
 
 

@@ -14,6 +14,13 @@ rendezvous** process row is added: one thread per rank, one slice per
 span, named by its collective site — laid on the same wall axis as the
 pipeline rows, the staircase of enters at one (site, round) IS the skew
 the analyzer prices.
+
+When ``incidents`` (chainwatch incident records, as carried by shards /
+``/incidents``) are passed, an **incidents** annotation row is added:
+one instant marker per incident at its ``opened_at`` wall time, named
+``incident:<rule>`` — scrub to the marker and the surrounding pipeline /
+collective /critical-path slices ARE the evidence window the incident
+bundle snapshotted.
 """
 from __future__ import annotations
 
@@ -23,6 +30,8 @@ from ..meshwatch.pipeline import to_chrome_trace
 CRITICAL_PID = 999999
 #: The collective-rendezvous row's pid — just under the critical path.
 COLLECTIVE_PID = 999998
+#: The chainwatch incident-annotation row's pid — under the collectives.
+INCIDENT_PID = 999997
 
 
 def _collective_lane(events: list, skew_spans: dict, epoch: float) -> None:
@@ -54,11 +63,41 @@ def _collective_lane(events: list, skew_spans: dict, epoch: float) -> None:
             })
 
 
+def _incident_lane(events: list, incidents: list, epoch: float) -> None:
+    """Append the chainwatch annotation row: one process-scoped instant
+    marker per incident at its ``opened_at``, args carrying the record's
+    identity (rule, severity, seq, implicated heights, firing rank)."""
+    events.append({"ph": "M", "name": "process_name",
+                   "pid": INCIDENT_PID, "tid": 0,
+                   "args": {"name": "chainwatch incidents"}})
+    for inc in incidents:
+        try:
+            ts = (float(inc["opened_at"]) - epoch) * 1e6
+            rule = str(inc["rule"])
+        except (KeyError, TypeError, ValueError):
+            continue
+        args = {"rule": rule,
+                "severity": inc.get("severity", ""),
+                "incident_seq": inc.get("incident_seq"),
+                "heights": list(inc.get("heights") or ())}
+        if inc.get("rank") is not None:
+            args["rank"] = inc["rank"]
+        events.append({
+            "ph": "i", "s": "p", "cat": "incident",
+            "name": f"incident:{rule}",
+            "pid": INCIDENT_PID, "tid": 0, "ts": round(ts, 3),
+            "args": args,
+        })
+
+
 def to_critical_path_trace(report: dict, records: list[dict],
-                           skew_spans: dict | None = None) -> dict:
+                           skew_spans: dict | None = None,
+                           incidents: list | None = None) -> dict:
     """Chrome trace-event JSON: base pipeline rows + the critical-path
     row (+ the collective lane when per-rank ``skew_spans`` — a mapping
-    rank -> span list, as carried by meshwatch shards — are passed).
+    rank -> span list, as carried by meshwatch shards — are passed,
+    + the incident annotation lane when chainwatch ``incidents`` —
+    rank-stamped records as served by ``/incidents`` — are passed).
     Deterministic for a deterministic (report, records) pair."""
     trace = to_chrome_trace(records)
     events = trace["traceEvents"]
@@ -71,6 +110,16 @@ def to_critical_path_trace(report: dict, records: list[dict],
             # pipeline segments at all, the earliest enter is the epoch.
             lane_epoch = epoch if epoch is not None else min(enters)
             _collective_lane(events, skew_spans, lane_epoch)
+            trace.setdefault("metadata", {}).setdefault(
+                "epoch_unix_s", lane_epoch)
+    if incidents:
+        opened = [float(i["opened_at"]) for i in incidents
+                  if i.get("opened_at") is not None]
+        if opened:
+            lane_epoch = trace.get("metadata", {}).get("epoch_unix_s")
+            lane_epoch = lane_epoch if lane_epoch is not None \
+                else min(opened)
+            _incident_lane(events, incidents, lane_epoch)
             trace.setdefault("metadata", {}).setdefault(
                 "epoch_unix_s", lane_epoch)
     if epoch is None:       # no segments at all: nothing to highlight

@@ -45,7 +45,7 @@ from __future__ import annotations
 import statistics
 import time
 
-from .. import core
+from .. import chainwatch, core
 from ..meshprof.spans import skew_span
 from ..telemetry import counter, heartbeat, set_telemetry_disabled
 from ..telemetry.spans import span
@@ -85,6 +85,13 @@ def _instrumented_round(profiler, height: int, base: int, chunk: int):
         # same paired audit — the off half pays only its flag check.
         with skew_span(site="trace-audit"):
             pass
+        # The chainwatch watchdog step — the newest per-round emit
+        # point: rule evaluation rides the same audit so the ≤3% gate
+        # prices the live SLO rules too. The off half pays only the
+        # flag check (evaluate returns on telemetry_disabled), and the
+        # audits arm chainwatch so the on half pays the real sweep
+        # throttle + rules.
+        chainwatch.evaluate(height=height, source="audit")
     return prec
 
 
@@ -145,6 +152,12 @@ def measure_block_observe(samples: int = 400,
     times: list[float] = []
     base = 0
     prev = set_telemetry_disabled(False)
+    # Arm the watchdog so the timed observation pays chainwatch's real
+    # per-block cost (the throttle check, occasionally a full sweep) —
+    # the same path the mining loop pays once `mine` arms it.
+    was_armed = chainwatch.installed()
+    if not was_armed:
+        chainwatch.install()
     try:
         for i in range(max(8, samples)):
             prec = _instrumented_round(profiler, i + 1, base, chunk)
@@ -155,6 +168,8 @@ def measure_block_observe(samples: int = 400,
             times.append((time.perf_counter() - t0) * 1e6)
     finally:
         set_telemetry_disabled(prev)
+        if not was_armed:
+            chainwatch.uninstall()
     times.sort()
     return {
         "backend": "cpu",
@@ -175,7 +190,19 @@ def measure_trace_overhead(seconds: float = 1.0, reps: int = 3,
     negative on a noisy box (the off halves drew the slower slices);
     the gate only bounds the upside."""
     chunk = 1 << chunk_pow2
-    rep_runs = [_paired_rep(seconds, chunk) for _ in range(max(1, reps))]
+    # Armed watchdog: the on half pays chainwatch's live cost (throttle
+    # check, periodically a full rule sweep); the off half pays only the
+    # kill-switch flag check — so the paired delta prices rule
+    # evaluation under the same ≤3% gate as every other emit point.
+    was_armed = chainwatch.installed()
+    if not was_armed:
+        chainwatch.install()
+    try:
+        rep_runs = [_paired_rep(seconds, chunk)
+                    for _ in range(max(1, reps))]
+    finally:
+        if not was_armed:
+            chainwatch.uninstall()
     pooled = [d for deltas, _, _ in rep_runs for d in deltas]
     rep_medians = [statistics.median(deltas) for deltas, _, _ in rep_runs]
     return {

@@ -1,0 +1,207 @@
+"""CLI: python -m mpi_blockchain_tpu.chainwatch {smoke}
+
+``smoke`` is the CI shape (``make incident-smoke``), pinning BOTH sides
+of the watchdog contract end-to-end in real processes:
+
+* **Detection** — a 4-rank cpu ``--mesh-obs`` world where one rank runs
+  under a deterministic fault plan (two consecutive injected
+  ``backend.cpu.search`` raises) must produce EXACTLY the expected
+  incident: the injected faults and their retries are a 4-event burst,
+  so with the storm threshold lowered to 3 the faulted rank fires
+  ``event_storm`` — once (debounce + hysteresis), non-fatally (the
+  retry ladder absorbs the faults; every rank still exits 0), with a
+  complete, schema-pinned evidence bundle (``BUNDLE_KEYS``) on disk and
+  the open incident carried by the rank's final shard into the merged
+  mesh view.
+
+* **False-positive pin** — the same world, same seed/difficulty, no
+  fault plan, must produce ZERO incidents: no bundle, no ``incident``
+  event, no ``incidents_total`` series in any shard. Every chainwatch
+  threshold errs quiet; this is the gate that keeps it true.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+
+def _spawn_rank(rank: int, world: int, obs_dir: str, blocks: int,
+                extra_env: dict | None = None, extra: tuple = ()):
+    import os
+    import subprocess
+
+    env = {**os.environ,
+           "JAX_PLATFORMS": "cpu",
+           "MPIBT_MESH_RANK": str(rank),
+           "MPIBT_MESH_WORLD": str(world),
+           "MPIBT_MESH_OBS_INTERVAL": "0.2",
+           **(extra_env or {})}
+    argv = [sys.executable, "-m", "mpi_blockchain_tpu", "mine",
+            "--backend", "cpu", "--difficulty", "8",
+            "--blocks", str(blocks), "--mesh-obs", obs_dir, *extra]
+    return subprocess.Popen(argv, env=env, stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True)
+
+
+def _run_world(obs: str, blocks: int, faulted_rank: int | None,
+               fault_extra: tuple = (), fault_env: dict | None = None,
+               world: int = 4) -> str | None:
+    """Run the world to completion; every rank must exit 0 (the
+    watchdog is non-fatal by contract). Returns an error string."""
+    procs = {}
+    try:
+        for r in range(world):
+            if r == faulted_rank:
+                procs[r] = _spawn_rank(r, world, obs, blocks,
+                                       extra_env=fault_env,
+                                       extra=fault_extra)
+            else:
+                procs[r] = _spawn_rank(r, world, obs, blocks)
+        for r, p in procs.items():
+            out, err = p.communicate(timeout=120)
+            if p.returncode != 0:
+                return (f"rank {r} exited rc={p.returncode} "
+                        f"(the watchdog must be non-fatal): {err[-800:]}")
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    return None
+
+
+def cmd_smoke(args) -> int:
+    """The make incident-smoke gate: exact detection + zero-FP pin."""
+    import tempfile
+
+    from ..meshwatch.aggregate import mesh_incidents, read_shards
+    from .incident import BUNDLE_KEYS
+
+    victim = 2
+    with tempfile.TemporaryDirectory() as tmp:
+        tmp = pathlib.Path(tmp)
+
+        # ---- leg 1: the faulted world must yield EXACTLY one incident.
+        obs = str(tmp / "mesh_faulted")
+        inc_dir = tmp / "incidents"
+        plan = tmp / "plan.json"
+        # Calls 2 and 3 of the victim's cpu sweep raise: 2 injected
+        # faults + 2 retries = a 4-event burst the lowered storm
+        # threshold (3 within a wide window) must catch; attempt 3 of
+        # the retry ladder succeeds, so the run converges and exits 0.
+        plan.write_text(json.dumps({
+            "version": 1, "strict": True,
+            "faults": [{"site": "backend.cpu.search", "kind": "raise",
+                        "call": 2, "times": 2}]}))
+        err = _run_world(
+            obs, blocks=6, faulted_rank=victim,
+            fault_extra=("--fault-plan", str(plan),
+                         "--incident-dir", str(inc_dir)),
+            fault_env={"MPIBT_CHAINWATCH_STORM_N": "3",
+                       "MPIBT_CHAINWATCH_STORM_WINDOW": "60"})
+        if err:
+            print(f"incident-smoke: {err}", file=sys.stderr)
+            return 1
+        shards = read_shards(obs)
+        incidents = mesh_incidents(shards)
+        if [(i["rank"], i["rule"]) for i in incidents] != \
+                [(victim, "event_storm")]:
+            print(f"incident-smoke: expected exactly one event_storm "
+                  f"incident on rank {victim}, got "
+                  f"{[(i.get('rank'), i.get('rule')) for i in incidents]}",
+                  file=sys.stderr)
+            return 1
+        inc = incidents[0]
+        if inc["severity"] != "warn" or inc["incident_seq"] != 1:
+            print(f"incident-smoke: wrong incident identity: {inc}",
+                  file=sys.stderr)
+            return 1
+        bundles = sorted(inc_dir.glob("incident_*.json"))
+        if [b.name for b in bundles] != ["incident_0001_event_storm.json"]:
+            print(f"incident-smoke: expected exactly one bundle, got "
+                  f"{[b.name for b in bundles]}", file=sys.stderr)
+            return 1
+        bundle = json.loads(bundles[0].read_text())
+        missing = set(BUNDLE_KEYS) - set(bundle)
+        if missing:
+            print(f"incident-smoke: bundle incomplete, missing "
+                  f"{sorted(missing)}", file=sys.stderr)
+            return 1
+        if (bundle["artifact"] != "incident"
+                or bundle["rule"] != "event_storm"
+                or bundle["reason"] != "incident:event_storm"
+                or bundle["detail"].get("events", 0) < 3
+                or not any(e.get("event") == "fault_injected"
+                           for e in bundle["events"])):
+            print(f"incident-smoke: bundle evidence wrong: "
+                  f"rule={bundle['rule']!r} reason={bundle['reason']!r} "
+                  f"detail={bundle['detail']}", file=sys.stderr)
+            return 1
+        # The signal must also have reached the metric + event surfaces
+        # of the faulted rank's shard.
+        vshard = next(s for s in shards if s["rank"] == victim)
+        totals = vshard["registry"].get("incidents_total", [])
+        if sum(m["value"] for m in totals) != 1 or not any(
+                m["labels"] == {"rule": "event_storm", "severity": "warn"}
+                for m in totals):
+            print(f"incident-smoke: incidents_total wrong: {totals}",
+                  file=sys.stderr)
+            return 1
+        if not any(e.get("event") == "incident"
+                   and e.get("rule") == "event_storm"
+                   for e in vshard["events_tail"]):
+            print("incident-smoke: incident event missing from the "
+                  "faulted rank's event tail", file=sys.stderr)
+            return 1
+
+        # ---- leg 2: the clean fixed-seed world must yield ZERO.
+        obs_clean = str(tmp / "mesh_clean")
+        err = _run_world(obs_clean, blocks=6, faulted_rank=None)
+        if err:
+            print(f"incident-smoke: clean leg: {err}", file=sys.stderr)
+            return 1
+        clean_shards = read_shards(obs_clean)
+        if len(clean_shards) != 4:
+            print(f"incident-smoke: clean leg wrote "
+                  f"{len(clean_shards)}/4 shards", file=sys.stderr)
+            return 1
+        false_pos = mesh_incidents(clean_shards)
+        if false_pos:
+            print(f"incident-smoke: FALSE POSITIVE on a clean run: "
+                  f"{false_pos}", file=sys.stderr)
+            return 1
+        for s in clean_shards:
+            if s["registry"].get("incidents_total") or any(
+                    e.get("event") == "incident"
+                    for e in s["events_tail"]):
+                print(f"incident-smoke: clean rank {s['rank']} carries "
+                      f"incident residue", file=sys.stderr)
+                return 1
+
+    print(json.dumps({"event": "incident_smoke", "ok": True,
+                      "incident_rule": inc["rule"],
+                      "incident_rank": inc["rank"],
+                      "bundle_keys": len(bundle),
+                      "clean_incidents": 0}, sort_keys=True))
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m mpi_blockchain_tpu.chainwatch",
+        description="live SLO watchdog: CI smoke")
+    sub = parser.add_subparsers(dest="command", required=True)
+    p_smk = sub.add_parser(
+        "smoke",
+        help="the make incident-smoke gate: a fault-injected 4-rank "
+             "world must yield exactly the expected incident (complete "
+             "bundle), a clean run zero")
+    p_smk.set_defaults(fn=cmd_smoke)
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,385 @@
+"""chainwatch anomaly rules: streaming detectors over live telemetry.
+
+Every rule is a small state machine sampled on the cadences the stack
+already pays for — the meshwatch shard flush tick and the per-block
+``observe_block_metrics`` call — never a new thread, never a device
+query of its own. A rule reads only surfaces that already exist (the
+metrics registry, the event ring, the pipeline profiler, the memory
+watermarks) so evaluation stays host-only and cheap enough to live
+inside the ≤3% telemetry overhead budget (blocktrace/overhead.py
+prices it; perfwatch gates it).
+
+The firing discipline (``Rule.evaluate``) is shared by every detector:
+
+* **debounce** — a breach must persist for ``debounce_n`` consecutive
+  samples before the rule fires (one noisy sample is weather);
+* **hysteresis** — once fired, the rule is an *open episode*: it will
+  not fire again until the signal has been clean for ``clear_n``
+  consecutive samples (a flapping signal produces ONE incident, not a
+  stream);
+* **severity** — each rule carries ``warn`` or ``critical``; the
+  incident event/counter/bundle all carry it.
+
+The false-positive contract is load-bearing: a clean fixed-seed cpu
+mine must produce ZERO incidents (tests/test_chainwatch.py pins it
+across seeds, ``make incident-smoke`` pins it end-to-end), so every
+threshold errs quiet and every baseline is learned in-run, never
+absolute wall-clock.
+
+Thresholds are env-tunable (``MPIBT_CHAINWATCH_*`` — see
+docs/observability.md §chainwatch for the catalogue).
+"""
+from __future__ import annotations
+
+import collections
+import time
+
+from ..telemetry.events import env_number
+
+#: Severity levels, mildest first (render/sort order).
+SEVERITIES = ("warn", "critical")
+
+#: Event names that count toward the event-storm rule: retries,
+#: degradations, collective timeouts, injected faults — the "the run is
+#: absorbing damage" burst signals.
+STORM_EVENTS = frozenset({
+    "retry", "collective_timeout", "backend_rung_unavailable",
+    "speculative_dispatch_failed", "backend_probe_failed",
+    "fault_injected",
+})
+
+
+class Rule:
+    """Debounce/hysteresis wrapper around a boolean ``sample``.
+
+    Subclasses implement ``sample(ctx) -> (breach, detail)``; the base
+    class turns that stream into at-most-one firing per open episode.
+    ``ctx`` is the evaluation context dict chainwatch passes every rule
+    (see ``chainwatch.evaluate``): ``height``, ``source``, ``now``.
+    """
+
+    name = "rule"
+    severity = "warn"
+    debounce_n = 2
+    clear_n = 2
+
+    def __init__(self):
+        self._breach_streak = 0
+        self._clear_streak = 0
+        self.open = False
+        self.fired_total = 0
+
+    def sample(self, ctx: dict) -> tuple[bool, dict]:
+        raise NotImplementedError
+
+    def evaluate(self, ctx: dict) -> dict | None:
+        """One sampling step. Returns the firing detail dict exactly
+        once per episode (debounced breach while closed), else None."""
+        breach, detail = self.sample(ctx)
+        if breach:
+            self._breach_streak += 1
+            self._clear_streak = 0
+            if not self.open and self._breach_streak >= self.debounce_n:
+                self.open = True
+                self.fired_total += 1
+                return dict(detail)
+        else:
+            self._breach_streak = 0
+            if self.open:
+                self._clear_streak += 1
+                if self._clear_streak >= self.clear_n:
+                    self.open = False
+                    self._clear_streak = 0
+        return None
+
+    def reset(self) -> None:
+        self.__init__()
+
+
+# ---- rule catalogue --------------------------------------------------------
+
+
+class HashrateCollapse(Rule):
+    """EWMA hash rate vs the in-run rolling baseline.
+
+    Rate = Δ``hashes_tried_total`` (summed over labelsets in the live
+    registry) / Δwall between samples. The first ``warmup_n`` rates
+    build the baseline; after warmup the rule breaches while the EWMA
+    sits below ``collapse_frac`` of the rolling baseline. Short runs
+    never leave warmup, so they can never fire — mining-time variance
+    is geometric per block, but the *rate* is stable, which is exactly
+    why the rule watches rate and not block latency."""
+
+    name = "hashrate_collapse"
+    severity = "critical"
+    debounce_n = 3
+
+    def __init__(self):
+        super().__init__()
+        self.warmup_n = env_number("MPIBT_CHAINWATCH_HASHRATE_WARMUP", 8,
+                                   cast=int, minimum=2)
+        self.collapse_frac = env_number(
+            "MPIBT_CHAINWATCH_HASHRATE_FRAC", 0.4, cast=float, minimum=0)
+        self._last = None          # (wall, total hashes)
+        self._ewma = None
+        self._baseline = None
+        self._samples = 0
+
+    @staticmethod
+    def _total_hashes() -> float:
+        from ..telemetry import default_registry
+
+        snap = default_registry().snapshot().get("hashes_tried_total", [])
+        return float(sum(m.get("value", 0) for m in snap))
+
+    def sample(self, ctx):
+        now = ctx.get("now", time.monotonic())
+        total = self._total_hashes()
+        if self._last is None:
+            self._last = (now, total)
+            return False, {}
+        dt = now - self._last[0]
+        dh = total - self._last[1]
+        if dt <= 0 or dh <= 0:
+            # No new work between samples (same flush tick, idle rank):
+            # not evidence of collapse, not a sample.
+            return False, {}
+        self._last = (now, total)
+        rate = dh / dt
+        self._ewma = rate if self._ewma is None else \
+            0.3 * rate + 0.7 * self._ewma
+        self._samples += 1
+        if self._samples <= self.warmup_n:
+            self._baseline = self._ewma if self._baseline is None else \
+                0.2 * self._ewma + 0.8 * self._baseline
+            return False, {}
+        # Past warmup the baseline keeps drifting SLOWLY so a long run's
+        # legitimate plateau shift is absorbed, while a collapse is not.
+        self._baseline = 0.02 * self._ewma + 0.98 * self._baseline
+        breach = self._ewma < self.collapse_frac * self._baseline
+        return breach, {"ewma_rate": round(self._ewma, 3),
+                        "baseline_rate": round(self._baseline, 3),
+                        "collapse_frac": self.collapse_frac}
+
+
+class CollectiveSkewSpike(Rule):
+    """``collective_skew_ms`` p95 (live registry histogram, per site)
+    over the absolute bound. The histogram is populated by
+    ``meshprof.analyzer.publish_skew`` (the meshwatch analyze/skew CLIs
+    and the elastic supervisor's publishes); a world that never
+    publishes skew never feeds this rule."""
+
+    name = "collective_skew_spike"
+    severity = "warn"
+
+    def __init__(self):
+        super().__init__()
+        self.bound_ms = env_number("MPIBT_CHAINWATCH_SKEW_MS", 1000.0,
+                                   cast=float, minimum=0)
+        self.min_count = env_number("MPIBT_CHAINWATCH_SKEW_MIN_ROUNDS", 4,
+                                    cast=int, minimum=1)
+
+    def sample(self, ctx):
+        from ..telemetry import default_registry
+
+        worst = None
+        for m in default_registry().snapshot().get("collective_skew_ms", []):
+            p95 = m.get("p95")
+            if p95 is None or m.get("count", 0) < self.min_count:
+                continue
+            if worst is None or p95 > worst[0]:
+                worst = (p95, m.get("labels", {}).get("site", ""))
+        if worst is None or worst[0] <= self.bound_ms:
+            return False, {}
+        return True, {"skew_p95_ms": round(worst[0], 3),
+                      "site": worst[1], "bound_ms": self.bound_ms}
+
+
+class HbmWatermarkGrowth(Rule):
+    """Per-device ``last_bytes_in_use`` vs the first-seen in-run
+    baseline: sustained growth past ``growth_factor``× (above an
+    absolute floor, so cpu-host noise can't trip it) is the OOM
+    precursor worth an incident before the allocator kills the run.
+    Processes that never imported jax sample ``{}`` and never fire."""
+
+    name = "hbm_watermark_growth"
+    severity = "warn"
+    debounce_n = 3
+
+    def __init__(self):
+        super().__init__()
+        self.growth_factor = env_number(
+            "MPIBT_CHAINWATCH_HBM_GROWTH", 1.5, cast=float, minimum=1)
+        self.floor_bytes = env_number(
+            "MPIBT_CHAINWATCH_HBM_FLOOR", 64 * 1024 * 1024,
+            cast=int, minimum=0)
+        self._baseline: dict[str, float] = {}
+
+    def sample(self, ctx):
+        from ..meshprof.memory import memory_snapshot
+
+        worst = None
+        for dev, mark in memory_snapshot().items():
+            cur = mark.get("last_bytes_in_use", 0)
+            base = self._baseline.setdefault(dev, cur)
+            if base <= 0 or cur < self.floor_bytes:
+                continue
+            ratio = cur / base
+            if ratio > self.growth_factor and (
+                    worst is None or ratio > worst[0]):
+                worst = (ratio, dev, cur, base)
+        if worst is None:
+            return False, {}
+        return True, {"device": worst[1], "growth": round(worst[0], 3),
+                      "bytes_in_use": worst[2], "baseline_bytes": worst[3],
+                      "growth_factor": self.growth_factor}
+
+
+class StaleRank(Rule):
+    """Mesh membership damage straight off the event ring:
+    ``mesh_shrunk`` (an eviction), ``mesh_rank_stale``/
+    ``mesh_rank_failed`` (the aggregator's transition announcements) or
+    ``rank_death`` since the last sample. Membership loss is definitive
+    — no debounce — and the episode stays open until the ring goes
+    quiet, so one evicted rank is one incident even though the
+    aggregator keeps re-reading the dead shard."""
+
+    name = "stale_rank"
+    severity = "critical"
+    debounce_n = 1
+
+    WATCHED = ("mesh_shrunk", "mesh_rank_stale", "mesh_rank_failed",
+               "rank_death")
+
+    def __init__(self):
+        super().__init__()
+        self._since = None
+
+    def sample(self, ctx):
+        from ..telemetry.events import latest_seq, recent_with_seq
+
+        if self._since is None:
+            # First sample anchors past history: pre-install events are
+            # the installer's context, not a live anomaly.
+            self._since = latest_seq()
+            return False, {}
+        hits = [e for _, e in recent_with_seq(since=self._since)
+                if e.get("event") in self.WATCHED]
+        self._since = latest_seq()
+        if not hits:
+            return False, {}
+        last = hits[-1]
+        return True, {"events": len(hits), "last_event": last.get("event"),
+                      "rank": last.get("evicted", last.get("rank")),
+                      "reason": last.get("reason", "")}
+
+
+class BubbleRegression(Rule):
+    """Pipeline ``bubble_fraction`` regression vs the in-run baseline.
+
+    Reads ``pipeline_report`` over the profiler's recent records —
+    interval math over a bounded tail, so the rule self-throttles to at
+    most one real computation per ``min_interval_s`` (throttled samples
+    cost one clock read, the same discipline as
+    ``meshprof.memory.sample_memory``). Absolute bubble is backend
+    weather (a cpu world is all bubble); only a REGRESSION against this
+    run's own warmup baseline fires."""
+
+    name = "bubble_regression"
+    severity = "warn"
+    debounce_n = 3
+
+    TAIL = 128
+
+    def __init__(self):
+        super().__init__()
+        self.warmup_n = env_number("MPIBT_CHAINWATCH_BUBBLE_WARMUP", 6,
+                                   cast=int, minimum=2)
+        self.margin = env_number("MPIBT_CHAINWATCH_BUBBLE_MARGIN", 0.3,
+                                 cast=float, minimum=0)
+        self.min_interval_s = env_number(
+            "MPIBT_CHAINWATCH_BUBBLE_INTERVAL", 0.5, cast=float, minimum=0)
+        self._last_eval = 0.0
+        self._baseline = None
+        self._samples = 0
+        self._breach_hold = False
+
+    def sample(self, ctx):
+        now = ctx.get("now", time.monotonic())
+        if now - self._last_eval < self.min_interval_s:
+            # Throttled: hold the last verdict so debounce streaks are
+            # counted in real samples, not in call frequency.
+            return self._breach_hold, {}
+        self._last_eval = now
+        from ..meshwatch.pipeline import pipeline_report, profiler
+
+        rep = pipeline_report(profiler().records(tail=self.TAIL))
+        bubble = rep.get("bubble_fraction")
+        if bubble is None:
+            self._breach_hold = False
+            return False, {}
+        self._samples += 1
+        if self._samples <= self.warmup_n or self._baseline is None:
+            self._baseline = bubble if self._baseline is None else \
+                0.5 * bubble + 0.5 * self._baseline
+            self._breach_hold = False
+            return False, {}
+        # bubble_fraction <= 1.0, so a baseline within `margin` of full
+        # idle can never breach — regression detection, not an absolute
+        # bound (a cpu world's natural bubble is weather, not an SLO).
+        breach = bubble > self._baseline + self.margin
+        if not breach:
+            self._baseline = 0.1 * bubble + 0.9 * self._baseline
+        self._breach_hold = breach
+        return breach, {"bubble_fraction": bubble,
+                        "baseline": round(self._baseline, 4),
+                        "margin": self.margin}
+
+
+class EventStorm(Rule):
+    """Burst of damage-absorption events (``STORM_EVENTS``) over the
+    ring: ``storm_n`` or more inside ``window_s`` breaches. A healthy
+    run emits none of these; a run riding its retry budget hard is
+    degrading even when every retry succeeds."""
+
+    name = "event_storm"
+    severity = "warn"
+    debounce_n = 1
+
+    def __init__(self):
+        super().__init__()
+        self.storm_n = env_number("MPIBT_CHAINWATCH_STORM_N", 10,
+                                  cast=int, minimum=1)
+        self.window_s = env_number("MPIBT_CHAINWATCH_STORM_WINDOW", 10.0,
+                                   cast=float, minimum=0.1)
+        self._since = None
+        self._times: collections.deque = collections.deque(maxlen=4096)
+
+    def sample(self, ctx):
+        from ..telemetry.events import latest_seq, recent_with_seq
+
+        now = ctx.get("now", time.monotonic())
+        if self._since is None:
+            self._since = latest_seq()
+            return False, {}
+        hits = [e for _, e in recent_with_seq(since=self._since)
+                if e.get("event") in STORM_EVENTS]
+        self._since = latest_seq()
+        for e in hits:
+            self._times.append((now, e.get("event")))
+        while self._times and now - self._times[0][0] > self.window_s:
+            self._times.popleft()
+        if len(self._times) < self.storm_n:
+            return False, {}
+        kinds = collections.Counter(k for _, k in self._times)
+        return True, {"events": len(self._times),
+                      "window_s": self.window_s,
+                      "kinds": dict(sorted(kinds.items()))}
+
+
+def default_rules() -> list[Rule]:
+    """Fresh instances of the full catalogue, evaluation order fixed
+    (docs/observability.md §chainwatch documents each row)."""
+    return [HashrateCollapse(), CollectiveSkewSpike(),
+            HbmWatermarkGrowth(), StaleRank(), BubbleRegression(),
+            EventStorm()]

@@ -75,6 +75,14 @@ def _add_metrics_dump_arg(p: argparse.ArgumentParser) -> None:
                         "mpi_blockchain_tpu.meshwatch (env MPIBT_MESH_OBS "
                         "also arms it; rank from --process-id or "
                         "MPIBT_MESH_RANK)")
+    p.add_argument("--incident-dir", metavar="DIR", default=None,
+                   help="arm the chainwatch live SLO watchdog with an "
+                        "incident-bundle directory: anomaly rules run on "
+                        "the existing telemetry cadences and a firing "
+                        "rule writes a bounded, non-fatal evidence "
+                        "bundle into DIR while the run keeps mining "
+                        "(env MPIBT_INCIDENT_DIR also arms it; "
+                        "--mesh-obs arms the rules without bundles)")
     p.add_argument("--fault-plan", metavar="PATH|seed:N", default=None,
                    help="arm the deterministic fault-injection harness "
                         "with a JSON fault plan (or a seed-derived one); "
@@ -893,6 +901,22 @@ def main(argv: list[str] | None = None) -> int:
             shard_armed = True
             print(f"mesh-obs: rank {rank}/{world} shard -> {mesh_obs}",
                   file=sys.stderr, flush=True)
+    incident_dir = getattr(args, "incident_dir", None)
+    if incident_dir is None and hasattr(args, "incident_dir"):
+        incident_dir = os.environ.get("MPIBT_INCIDENT_DIR") or None
+    chainwatch_armed = False
+    if incident_dir or shard_armed:
+        # The live SLO watchdog: anomaly rules ride the cadences armed
+        # above (the shard flush tick, the per-block observe call). An
+        # incident directory adds the evidence bundles; a mesh-observed
+        # run without one still signals (incident event + counter +
+        # shard/healthz carriage) — so --mesh-obs alone arms the rules.
+        from . import chainwatch
+        chainwatch.install(incident_dir or None)
+        chainwatch_armed = True
+        if incident_dir:
+            print(f"chainwatch: armed, incident bundles -> "
+                  f"{incident_dir}", file=sys.stderr, flush=True)
     try:
         if fault_arg:
             from .resilience import injection
@@ -965,6 +989,11 @@ def main(argv: list[str] | None = None) -> int:
         if shard_armed:
             from .meshwatch import shard as _mesh_shard
             _mesh_shard.uninstall(status=exit_status)
+        # AFTER the final shard write: the goodbye shard still carries
+        # any open incidents; only then does the watchdog disarm.
+        if chainwatch_armed:
+            from . import chainwatch
+            chainwatch.uninstall()
         # The endpoint must release its port on EVERY exit path — an
         # uncaught exception passes through here on its way to the
         # flight-recorder excepthook, and a wedged scrape thread is

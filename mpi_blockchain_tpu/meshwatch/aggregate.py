@@ -267,7 +267,7 @@ def mesh_health(directory, stall_s: float | None = None,
                      "stale_ranks": [], "failed_ranks": [],
                      "missing_ranks": [],
                      "live_ranks": 0, "world_size": 0,
-                     "skew": {}, "memory": {}}
+                     "skew": {}, "memory": {}, "incidents": []}
     status = rank_status(shards, stall_s=stall_s, now=now,
                          heartbeat_stall_s=heartbeat_stall_s)
     ranks = status["ranks"]
@@ -322,8 +322,26 @@ def mesh_health(directory, stall_s: float | None = None,
         "ranks": ranks,
         "skew": skew_summary(analyze_skew(shards)),
         "memory": memory,
+        # Open chainwatch incidents across the mesh, rank-stamped.
+        # Additive like skew/memory: [] when no rank carries any, and
+        # every pre-existing key keeps its shape (the schema pin in
+        # tests/test_meshwatch.py).
+        "incidents": mesh_incidents(shards),
     }
     return (200 if healthy else 503), payload
+
+
+def mesh_incidents(shards: list[dict]) -> list[dict]:
+    """Every open incident carried by a shard set, each stamped with
+    the reporting rank, ordered (rank, incident_seq). Pure function —
+    the ``/incidents`` endpoint and ``perfwatch incidents`` share it."""
+    out: list[dict] = []
+    for shard in shards:
+        for inc in shard.get("incidents") or ():
+            if isinstance(inc, dict):
+                out.append({**inc, "rank": int(shard["rank"])})
+    out.sort(key=lambda i: (i["rank"], i.get("incident_seq", 0)))
+    return out
 
 
 # ---- Prometheus rendering -------------------------------------------------

@@ -10,7 +10,10 @@ serves
   gauges/histograms per-rank under ``rank``, ``mesh_live_ranks`` /
   ``mesh_rank_up`` liveness series);
 * ``/ranks`` — the per-rank liveness JSON (status, stale reason, shard
-  age, heartbeat age, pid).
+  age, heartbeat age, pid);
+* ``/incidents`` — every open chainwatch incident carried by the
+  shards, rank-stamped (the live-SLO view; ``/healthz`` carries the
+  same list under its additive ``incidents`` key).
 
 Run it with ``python -m mpi_blockchain_tpu.meshwatch watch --dir DIR``.
 The lifecycle scaffolding (bind, daemon serve thread, idempotent
@@ -24,8 +27,8 @@ from __future__ import annotations
 import json
 
 from ..perfwatch.server import MetricsServer, _Handler
-from .aggregate import merge_shards, mesh_health, read_shards, \
-    render_mesh_prometheus
+from .aggregate import merge_shards, mesh_health, mesh_incidents, \
+    read_shards, render_mesh_prometheus
 
 
 class _MeshHandler(_Handler):
@@ -51,10 +54,17 @@ class _MeshHandler(_Handler):
             self._send(200, json.dumps(health.get("ranks", {}),
                                        sort_keys=True) + "\n",
                        "application/json")
+        elif path == "/incidents":
+            incidents = mesh_incidents(read_shards(ctx.directory))
+            self._send(200, json.dumps({"incidents": incidents,
+                                        "count": len(incidents)},
+                                       sort_keys=True) + "\n",
+                       "application/json")
         else:
             self._send(404, json.dumps({
                 "error": f"unknown path {path!r}",
-                "endpoints": ["/healthz", "/metrics", "/ranks"]}) + "\n",
+                "endpoints": ["/healthz", "/incidents", "/metrics",
+                              "/ranks"]}) + "\n",
                 "application/json")
 
 

@@ -28,7 +28,10 @@ seconds (default 1.0) and once more at close. The shard carries:
 * ``skew_spans`` — the newest rendezvous skew spans (``meshprof``: the
   mesh-skew analyzer joins them across shards on (site, round));
 * ``memory`` — per-device memory watermarks (empty on ranks that never
-  imported jax).
+  imported jax);
+* ``incidents`` — the rank's open chainwatch incidents (empty while
+  the watchdog is disarmed); the flush tick is also one of chainwatch's
+  two rule-evaluation cadences.
 
 Wall-clock timestamps are deliberate here (unlike the causal logs):
 staleness is a wall-clock question, and shards never participate in the
@@ -115,10 +118,18 @@ class ShardWriter:
         with self._lock:
             self._seq += 1
             seq = self._seq
+        from ..chainwatch import evaluate as chainwatch_evaluate
+        from ..chainwatch import open_incidents
         from ..meshprof.memory import memory_snapshot
         from ..meshprof.spans import SKEW_TAIL_N, spans_tail
         from .pipeline import profiler
 
+        # The shard-flush tick is one of chainwatch's two sanctioned
+        # evaluation cadences (the other: observe_block_metrics). This
+        # runs on the flusher daemon thread — off the mining hot path —
+        # so the full rule sweep is forced, no throttle. Disarmed/off
+        # processes pay a flag check.
+        chainwatch_evaluate(source="flush", force=True)
         return {
             "version": SHARD_VERSION,
             "rank": self.rank,
@@ -147,6 +158,10 @@ class ShardWriter:
             # that never imported jax).
             "skew_spans": spans_tail(SKEW_TAIL_N),
             "memory": memory_snapshot(),
+            # Open chainwatch incidents ride the shard (same carriage
+            # model as skew_spans/memory: [] while disarmed) so the
+            # aggregator's /healthz and /incidents views see them.
+            "incidents": open_incidents(),
         }
 
     # ---- writing ---------------------------------------------------------

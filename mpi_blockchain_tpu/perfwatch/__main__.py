@@ -1,5 +1,5 @@
 """CLI: python -m mpi_blockchain_tpu.perfwatch
-{record,check,report,critical-path,mesh-skew,serve}
+{record,check,report,critical-path,mesh-skew,incidents,serve}
 
 The perf-regression sentinel as a merge gate:
 
@@ -26,6 +26,10 @@ The perf-regression sentinel as a merge gate:
     # straggler rank, lag, idle chip-time (meshprof)
     python -m mpi_blockchain_tpu.perfwatch mesh-skew \\
         --mesh-dir /tmp/mesh --json
+
+    # open chainwatch incidents of a mesh (+ evidence bundles)
+    python -m mpi_blockchain_tpu.perfwatch incidents \\
+        --mesh-dir /tmp/mesh --bundle-dir /tmp/incidents --json
 
     # standalone endpoint (mine/sim/bench embed the same server via
     # --serve-metrics PORT); serves until interrupted
@@ -135,6 +139,11 @@ def cmd_check(args) -> int:
     # measured rate sits lower on the roofline, and the stale recorded
     # `utilization` payloads must not mask that headroom.
     roofline = _current_roofline(store)
+    # Incident context: a regression verdict reads differently when the
+    # run it judges fired chainwatch incidents (the candidate's slowness
+    # may BE the incident). Context only — never the gate.
+    incidents = _mesh_open_incidents(args.mesh_dir) \
+        if getattr(args, "mesh_dir", None) else None
     try:
         if args.as_json:
             doc = {"event": "perfwatch_check",
@@ -143,6 +152,9 @@ def cmd_check(args) -> int:
                    "findings": [f.to_dict() for f in findings]}
             if roofline:
                 doc["roofline"] = roofline
+            if incidents is not None:
+                doc["incidents"] = incidents
+                doc["incident_count"] = len(incidents)
             print(json.dumps(doc, sort_keys=True))
         else:
             for f in findings:
@@ -154,6 +166,12 @@ def cmd_check(args) -> int:
                       f"roofline at the committed census "
                       f"({roofline['alu_ops_per_nonce']} ALU ops/nonce)",
                       file=sys.stderr)
+            if incidents:
+                for line in _render_incidents(incidents):
+                    print(line, file=sys.stderr)
+            if incidents is not None:
+                print(f"perfwatch: {len(incidents)} open chainwatch "
+                      f"incident(s) in the judged mesh", file=sys.stderr)
             print(f"perfwatch: {len(bad)} regression(s) across "
                   f"{len(findings)} series", file=sys.stderr)
     except BrokenPipeError:
@@ -262,6 +280,60 @@ def cmd_mesh_skew(args) -> int:
     return 0
 
 
+def _mesh_open_incidents(mesh_dir) -> list[dict]:
+    """Rank-stamped open chainwatch incidents from a --mesh-obs shard
+    directory (the same merge `/incidents` serves)."""
+    from ..meshwatch.aggregate import mesh_incidents, read_shards
+
+    return mesh_incidents(read_shards(mesh_dir))
+
+
+def _render_incidents(incidents: list[dict]) -> list[str]:
+    lines = []
+    for inc in incidents:
+        heights = inc.get("heights") or []
+        at = ("@" + ",".join(str(h) for h in heights)) if heights else ""
+        lines.append(
+            f"  [{inc.get('severity', '?'):>8}] rank "
+            f"{inc.get('rank', '?')} {inc.get('rule', '?')}{at} "
+            f"(seq {inc.get('incident_seq', '?')}, "
+            f"source {inc.get('source', '')!r})")
+    return lines
+
+
+def cmd_incidents(args) -> int:
+    """Open chainwatch incidents of a mesh (from --mesh-dir shards, or
+    this process's open table for embedded callers), plus any evidence
+    bundles under --bundle-dir. Exit 0 always — reporting, not gating
+    (``check`` is the gate; ``incident-smoke`` pins the contract)."""
+    if args.mesh_dir:
+        incidents = _mesh_open_incidents(args.mesh_dir)
+        source = str(args.mesh_dir)
+    else:
+        from ..chainwatch import open_incidents
+        incidents = open_incidents()
+        source = "in-process"
+    bundles = []
+    if args.bundle_dir:
+        bundles = sorted(str(p.name) for p in
+                         pathlib.Path(args.bundle_dir).glob(
+                             "incident_*.json"))
+    if args.as_json:
+        print(json.dumps({"event": "perfwatch_incidents",
+                          "source": source, "count": len(incidents),
+                          "incidents": incidents, "bundles": bundles},
+                         sort_keys=True))
+    else:
+        print(f"incidents: {len(incidents)} open ({source})")
+        for line in _render_incidents(incidents):
+            print(line)
+        if args.bundle_dir:
+            print(f"bundles: {len(bundles)} under {args.bundle_dir}")
+            for name in bundles:
+                print(f"  {name}")
+    return 0
+
+
 def cmd_critical_path(args) -> int:
     """Per-block critical-path attribution (blocktrace): joins pipeline
     records mesh-wide (from --mesh-dir shards, or the in-process
@@ -269,20 +341,25 @@ def cmd_critical_path(args) -> int:
     from ..blocktrace.critical_path import critical_path_report, render_text
 
     skew_spans: dict = {}
+    incidents: list = []
     if args.mesh_dir:
-        from ..meshwatch.aggregate import read_shards
+        from ..meshwatch.aggregate import mesh_incidents, read_shards
         shards = read_shards(args.mesh_dir)
         records = [r for s in shards for r in s.get("pipeline") or []]
         skew_spans = {str(s["rank"]): s["skew_spans"] for s in shards
                       if s.get("skew_spans") and s.get("rank") is not None}
+        incidents = mesh_incidents(shards)
     else:
+        from ..chainwatch import open_incidents
         from ..meshwatch.pipeline import profiler
         records = profiler().records()
+        incidents = open_incidents()
     report = critical_path_report(records, height=args.height)
     if args.trace:
         from ..blocktrace.export import to_critical_path_trace
         trace = to_critical_path_trace(report, records,
-                                       skew_spans=skew_spans)
+                                       skew_spans=skew_spans,
+                                       incidents=incidents)
         pathlib.Path(args.trace).write_text(
             json.dumps(trace, sort_keys=True))
     if args.as_json:
@@ -439,6 +516,10 @@ def main(argv: list[str] | None = None) -> int:
                        help="judge this payload against history without "
                             "recording it")
     p_chk.add_argument("--json", action="store_true", dest="as_json")
+    p_chk.add_argument("--mesh-dir", metavar="DIR", default=None,
+                       help="also report the open chainwatch incidents "
+                            "of this --mesh-obs shard directory as "
+                            "verdict context (never the gate)")
     p_chk.set_defaults(fn=cmd_check)
 
     p_rep = sub.add_parser("report", help="trajectory + roofline + "
@@ -482,6 +563,19 @@ def main(argv: list[str] | None = None) -> int:
                             "skew_spans to join")
     p_skw.add_argument("--json", action="store_true", dest="as_json")
     p_skw.set_defaults(fn=cmd_mesh_skew)
+
+    p_inc = sub.add_parser(
+        "incidents",
+        help="open chainwatch incidents (from a --mesh-obs shard "
+             "directory or this process) + evidence bundle listing")
+    p_inc.add_argument("--mesh-dir", metavar="DIR", default=None,
+                       help="the --mesh-obs shard directory whose open "
+                            "incidents to merge (default: in-process)")
+    p_inc.add_argument("--bundle-dir", metavar="DIR", default=None,
+                       help="also list incident bundles written here "
+                            "(mine --incident-dir)")
+    p_inc.add_argument("--json", action="store_true", dest="as_json")
+    p_inc.set_defaults(fn=cmd_incidents)
 
     p_srv = sub.add_parser("serve", help="standalone metrics endpoint "
                                          "(until interrupted)")

@@ -318,6 +318,15 @@ class ElasticWorld:
         emit_event({"event": "mesh_shrunk", "rank": self.rank,
                     "evicted": rank, "reason": reason, "height": height,
                     "live": list(self.live)})
+        # The chainwatch seam: an eviction is definitive membership
+        # damage, so the watchdog fires its ``stale_rank`` incident NOW
+        # (with the surviving membership for the bundle) instead of
+        # waiting for the next cadence tick to read the ring. Lazy
+        # import + flag-check no-op while disarmed/off.
+        from .. import chainwatch
+
+        chainwatch.notify_eviction(rank, reason, height=height,
+                                   live=self.live)
         return True
 
     # -- the per-block supervision point -----------------------------------

@@ -26,6 +26,10 @@ Three trigger paths:
 
 Enable it with ``install(path)`` — the mine/sim/bench CLIs wire this to
 ``--flight-recorder PATH`` (or env ``MPIBT_FLIGHT_RECORDER``).
+
+``snapshot()`` is the reusable evidence body: the same state capture
+the crash dump writes, exposed so the chainwatch incident path can
+bundle identical forensics from a process that keeps running.
 """
 from __future__ import annotations
 
@@ -40,6 +44,11 @@ import traceback
 
 DEFAULT_LAST_N = 256
 
+#: Per-process ceiling on written artifacts (crash dumps + advisory
+#: dump_now calls). A flapping watchdog or an excepthook/atexit overlap
+#: must converge to a bounded set of files, not fill the disk.
+DUMP_CAP = 16
+
 _lock = threading.Lock()
 _state: dict = {
     "path": None,
@@ -48,6 +57,8 @@ _state: dict = {
     "prev_excepthook": None,
     "abnormal_reason": None,
     "dumped": False,
+    "dump_count": 0,   # successful writes this install (cap accounting)
+    "dumping": False,  # double-dump guard: a write is in flight
     "reasons": [],     # every dump reason so far, oldest first
     "networks": [],
     "context": {},
@@ -64,6 +75,7 @@ def install(path=None, last_n: int = DEFAULT_LAST_N) -> pathlib.Path:
             or f"flight_recorder_{os.getpid()}.json")
         _state["last_n"] = max(1, int(last_n))
         _state["dumped"] = False
+        _state["dump_count"] = 0
         _state["reasons"] = []
         _state["abnormal_reason"] = None
         if not _state["installed"]:
@@ -81,8 +93,8 @@ def uninstall() -> None:
         if _state["installed"] and _state["prev_excepthook"] is not None:
             sys.excepthook = _state["prev_excepthook"]
         _state.update(installed=False, prev_excepthook=None, path=None,
-                      abnormal_reason=None, dumped=False, reasons=[],
-                      networks=[], context={})
+                      abnormal_reason=None, dumped=False, dump_count=0,
+                      dumping=False, reasons=[], networks=[], context={})
 
 
 def installed() -> bool:
@@ -127,14 +139,22 @@ def dump_now(reason: str) -> pathlib.Path | None:
     return _dump(reason)
 
 
-def _snapshot(reason: str, tb: str | None = None) -> dict:
+def snapshot(reason: str, tb: str | None = None,
+             last_n: int | None = None) -> dict:
+    """The shared evidence body: event-ring tail, causal logs, registry
+    snapshot, span tail, process context. The crash path (``_dump``)
+    writes exactly this dict; chainwatch's incident bundles build on it
+    (same keys, plus incident-specific extras) so one schema serves both
+    the fatal and the non-fatal capture paths. ``last_n`` defaults to
+    the installed tail bound (or ``DEFAULT_LAST_N`` uninstalled)."""
     # Late imports: the recorder must be importable before telemetry is
     # fully initialized, and must never fail a crash path on an import.
     from .events import recent_events
     from .registry import default_registry
 
     with _lock:
-        last_n = _state["last_n"]
+        if last_n is None:
+            last_n = _state["last_n"]
         networks = list(_state["networks"])
         context = dict(_state["context"])
     reg = default_registry()
@@ -169,16 +189,26 @@ def _dump(reason: str, tb: str | None = None,
     """Write the artifact. ``only_if_first`` (the atexit path) refuses to
     overwrite an earlier, more specific dump; direct dumps (excepthook,
     watchdog dump_now) always write, recording superseded reasons in
-    ``prior_reasons`` so an advisory dump can never swallow a real crash."""
+    ``prior_reasons`` so an advisory dump can never swallow a real crash.
+
+    Two bounds keep a misbehaving trigger from writing unbounded
+    artifacts: a concurrent dump already in flight skips (the
+    excepthook/atexit overlap double-dump guard), and after ``DUMP_CAP``
+    successful writes this process stops dumping entirely."""
     with _lock:
         if not _state["installed"]:
             return None
         if only_if_first and _state["dumped"]:
             return None
+        if _state["dumping"]:
+            return None
+        if _state["dump_count"] >= DUMP_CAP:
+            return None
+        _state["dumping"] = True
         prior = list(_state["reasons"])
         path = _state["path"]
     try:
-        payload = _snapshot(reason, tb)
+        payload = snapshot(reason, tb)
         payload["prior_reasons"] = prior
         tmp = path.with_suffix(path.suffix + ".tmp")
         tmp.write_text(json.dumps(payload, sort_keys=True, default=str))
@@ -189,9 +219,13 @@ def _dump(reason: str, tb: str | None = None,
         # atexit fallback that might still succeed.
         print(f"flight-recorder dump failed: {e}", file=sys.stderr)
         return None
+    finally:
+        with _lock:
+            _state["dumping"] = False
     with _lock:
         _state["reasons"].append(reason)
         _state["dumped"] = True
+        _state["dump_count"] += 1
     return path
 
 

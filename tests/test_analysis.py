@@ -584,6 +584,102 @@ def test_tel005_cli_pass_family(tmp_path):
     assert "TEL005" in proc.stdout
 
 
+# ---- TEL006: rule/severity keywords at incident emit points ------------
+
+
+INCIDENT_EMITS = textwrap.dedent("""\
+    from mpi_blockchain_tpu.chainwatch import emit_incident
+    from mpi_blockchain_tpu.chainwatch import emit_incident as _emit_incident
+
+
+    def emit(rule, kw):
+        emit_incident(rule=rule)                       # no severity
+        _emit_incident(severity="warn")                # aliased, no rule
+        emit_incident()                                # neither
+        emit_incident(rule=rule, severity="warn")      # classified
+        emit_incident(**kw)                            # opaque spread
+    """)
+
+INCIDENT_CLEAN = textwrap.dedent("""\
+    from mpi_blockchain_tpu.chainwatch import emit_incident
+
+
+    def emit(detail):
+        emit_incident(rule="event_storm", severity="warn",
+                      detail=detail)
+    """)
+
+
+def test_tel006_unclassified_incident_emit_fires(tmp_path):
+    from mpi_blockchain_tpu.analysis.telemetry_lint import run_telemetry_lint
+
+    bad = tmp_path / "incident_emits.py"
+    bad.write_text(INCIDENT_EMITS)
+    findings = run_telemetry_lint(
+        ROOT, overrides={"incident_scope_files": [bad],
+                         "telemetry_files": []})
+    assert rule_set(findings) == {"TEL006"}
+    # no-severity + no-rule + neither (2) = 4; kw= and ** pass.
+    assert len(findings) == 4
+    assert all("classify" in f.message for f in findings)
+
+
+def test_tel006_clean_fixture_passes(tmp_path):
+    from mpi_blockchain_tpu.analysis.telemetry_lint import run_telemetry_lint
+
+    good = tmp_path / "incident_clean.py"
+    good.write_text(INCIDENT_CLEAN)
+    findings = run_telemetry_lint(
+        ROOT, overrides={"incident_scope_files": [good],
+                         "telemetry_files": []})
+    assert "TEL006" not in rule_set(findings)
+
+
+def test_tel006_out_of_scope_file_not_checked(tmp_path):
+    from mpi_blockchain_tpu.analysis.telemetry_lint import run_telemetry_lint
+
+    bad = tmp_path / "incident_emits.py"
+    bad.write_text(INCIDENT_EMITS)
+    findings = run_telemetry_lint(
+        ROOT, overrides={"incident_scope_files": [],
+                         "telemetry_files": [bad]})
+    assert "TEL006" not in rule_set(findings)
+
+
+def test_tel006_live_tree_clean():
+    """Every live incident emit point is classified, and the live scope
+    actually covers the subsystem plus the wired seams."""
+    from mpi_blockchain_tpu.analysis.telemetry_lint import (
+        _incident_scope_files, run_telemetry_lint)
+
+    rels = {str(p.relative_to(ROOT)) for p in _incident_scope_files(ROOT)}
+    for expected in ("mpi_blockchain_tpu/chainwatch/__init__.py",
+                     "mpi_blockchain_tpu/chainwatch/incident.py",
+                     "mpi_blockchain_tpu/chainwatch/rules.py",
+                     "mpi_blockchain_tpu/resilience/elastic.py",
+                     "mpi_blockchain_tpu/blocktrace/critical_path.py",
+                     "mpi_blockchain_tpu/meshwatch/shard.py"):
+        assert expected in rels, expected
+    findings = [f for f in run_telemetry_lint(ROOT)
+                if f.rule == "TEL006"]
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_tel006_cli_pass_family(tmp_path):
+    from mpi_blockchain_tpu.analysis.__main__ import OVERRIDE_KEYS
+
+    assert "incident_scope_files" in OVERRIDE_KEYS
+    bad = tmp_path / "incident_emits.py"
+    bad.write_text(INCIDENT_EMITS)
+    proc = subprocess.run(
+        [sys.executable, "-m", "mpi_blockchain_tpu.analysis",
+         "--passes", "telemetry", "--override",
+         f"incident_scope_files={bad}"],
+        cwd=ROOT, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "TEL006" in proc.stdout
+
+
 def test_tel002_cli_pass_family(tmp_path):
     bad = tmp_path / "bad_metrics.py"
     bad.write_text(BAD_METRICS)

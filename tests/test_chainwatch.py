@@ -1,0 +1,632 @@
+"""chainwatch subsystem tests (mpi_blockchain_tpu/chainwatch).
+
+Covers the shared debounce/hysteresis firing discipline, every rule in
+the catalogue against synthetic triggers (with the thresholds each rule
+reads pinned), the incident path (event + counter + open table +
+rate-limited/capped bundles with the schema pin), the evaluate seams
+(arming, throttle, the MPIBT_TELEMETRY_OFF flag-check contract, the
+eviction seam), the refactored flight-recorder snapshot body (crash
+dump == snapshot + prior_reasons; double-dump guard; artifact cap), the
+Perfetto incident lane, and the load-bearing false-positive contract:
+a clean fixed-seed cpu mine — sequential AND pipelined, three header
+seeds — produces ZERO incidents.
+"""
+import json
+import pathlib
+import time
+
+import pytest
+
+from mpi_blockchain_tpu import chainwatch, telemetry
+from mpi_blockchain_tpu.chainwatch import incident as cw_incident
+from mpi_blockchain_tpu.chainwatch.incident import BUNDLE_KEYS, build_bundle
+from mpi_blockchain_tpu.chainwatch.rules import (SEVERITIES, STORM_EVENTS,
+                                                 BubbleRegression,
+                                                 CollectiveSkewSpike,
+                                                 EventStorm,
+                                                 HashrateCollapse,
+                                                 HbmWatermarkGrowth, Rule,
+                                                 StaleRank, default_rules)
+from mpi_blockchain_tpu.meshwatch.pipeline import reset_profiler
+from mpi_blockchain_tpu.telemetry import flight_recorder
+from mpi_blockchain_tpu.telemetry.events import emit_event
+from mpi_blockchain_tpu.telemetry.registry import set_telemetry_disabled
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(autouse=True)
+def fresh_state():
+    telemetry.reset()
+    telemetry.clear_events()
+    telemetry.set_mesh_rank(0)
+    reset_profiler()
+    set_telemetry_disabled(False)
+    chainwatch.uninstall()
+    flight_recorder.uninstall()
+    yield
+    telemetry.reset()
+    telemetry.clear_events()
+    telemetry.set_mesh_rank(0)
+    reset_profiler()
+    set_telemetry_disabled(False)
+    chainwatch.uninstall()
+    flight_recorder.uninstall()
+
+
+# ---- the shared firing discipline --------------------------------------
+
+
+class _Toggle(Rule):
+    """Test rule: breach follows a settable flag."""
+    name = "toggle"
+    severity = "warn"
+    debounce_n = 2
+    clear_n = 2
+
+    def __init__(self):
+        super().__init__()
+        self.breach = False
+        self.samples = 0
+
+    def sample(self, ctx):
+        self.samples += 1
+        return self.breach, {"n": self.samples}
+
+
+def test_rule_debounce_one_noisy_sample_never_fires():
+    r = _Toggle()
+    r.breach = True
+    assert r.evaluate({}) is None          # streak 1 < debounce_n
+    r.breach = False
+    assert r.evaluate({}) is None          # streak reset
+    r.breach = True
+    assert r.evaluate({}) is None
+    assert r.fired_total == 0 and not r.open
+
+
+def test_rule_fires_once_per_episode_with_hysteresis():
+    r = _Toggle()
+    r.breach = True
+    assert r.evaluate({}) is None
+    detail = r.evaluate({})                # debounced breach: fires
+    assert detail == {"n": 2} and r.open and r.fired_total == 1
+    # Still breaching: the open episode never re-fires.
+    assert r.evaluate({}) is None
+    # One clean sample is not enough to close (hysteresis) — and a
+    # flap back into breach must NOT fire a second incident.
+    r.breach = False
+    assert r.evaluate({}) is None and r.open
+    r.breach = True
+    assert r.evaluate({}) is None and r.open
+    assert r.fired_total == 1
+    # clear_n consecutive clean samples close the episode...
+    r.breach = False
+    assert r.evaluate({}) is None
+    assert r.evaluate({}) is None
+    assert not r.open
+    # ...and only a fresh debounced breach opens (and fires) a new one.
+    r.breach = True
+    assert r.evaluate({}) is None
+    assert r.evaluate({}) is not None
+    assert r.fired_total == 2
+
+
+def test_default_rules_catalogue_shape():
+    rules = default_rules()
+    names = [r.name for r in rules]
+    assert names == ["hashrate_collapse", "collective_skew_spike",
+                     "hbm_watermark_growth", "stale_rank",
+                     "bubble_regression", "event_storm"]
+    assert all(r.severity in SEVERITIES for r in rules)
+    assert {r.name: r.severity for r in rules}["hashrate_collapse"] \
+        == "critical"
+    assert {r.name: r.severity for r in rules}["stale_rank"] == "critical"
+    # Fresh instances every install: no cross-run state bleed.
+    assert default_rules()[0] is not rules[0]
+
+
+# ---- rule catalogue against synthetic triggers -------------------------
+
+
+def test_hashrate_collapse_warmup_then_collapse(monkeypatch):
+    monkeypatch.setenv("MPIBT_CHAINWATCH_HASHRATE_WARMUP", "2")
+    r = HashrateCollapse()
+    c = telemetry.counter("hashes_tried_total", backend="cpu")
+    now = 100.0
+    # Steady warmup + plateau: never fires no matter how long.
+    for _ in range(8):
+        c.inc(100_000)
+        now += 1.0
+        assert r.evaluate({"now": now}) is None
+    assert not r.open
+    # Collapse: the EWMA decays below 40% of the rolling baseline and
+    # stays there; exactly one firing (debounce 3, then episode open).
+    fired = []
+    for _ in range(15):
+        c.inc(10)
+        now += 1.0
+        d = r.evaluate({"now": now})
+        if d is not None:
+            fired.append(d)
+    assert len(fired) == 1 and r.open
+    assert fired[0]["ewma_rate"] < 0.4 * fired[0]["baseline_rate"]
+    assert fired[0]["collapse_frac"] == pytest.approx(0.4)
+
+
+def test_hashrate_idle_rank_is_not_a_collapse(monkeypatch):
+    monkeypatch.setenv("MPIBT_CHAINWATCH_HASHRATE_WARMUP", "2")
+    r = HashrateCollapse()
+    c = telemetry.counter("hashes_tried_total", backend="cpu")
+    now = 0.0
+    for _ in range(6):
+        c.inc(50_000)
+        now += 1.0
+        r.evaluate({"now": now})
+    # No new hashes between samples (idle/flush-tick duplicates): not a
+    # sample at all — the breach streak cannot build.
+    for _ in range(10):
+        now += 1.0
+        assert r.evaluate({"now": now}) is None
+    assert not r.open and r.fired_total == 0
+
+
+def test_collective_skew_spike_needs_count_and_bound():
+    r = CollectiveSkewSpike()
+    h = telemetry.histogram("collective_skew_ms", site="winner_select")
+    for _ in range(3):
+        h.observe(5000.0)
+    # count 3 < min_rounds 4: a couple of noisy rounds are weather.
+    assert r.evaluate({}) is None and r._breach_streak == 0
+    h.observe(5000.0)
+    assert r.evaluate({}) is None          # debounce 1/2
+    d = r.evaluate({})                     # fires
+    assert d["site"] == "winner_select" and d["skew_p95_ms"] > 1000.0
+    assert d["bound_ms"] == pytest.approx(1000.0)
+
+
+def test_hbm_watermark_growth_fires_above_floor(monkeypatch):
+    marks = {"tpu:0": {"last_bytes_in_use": 200 * 1024 * 1024}}
+    monkeypatch.setattr("mpi_blockchain_tpu.meshprof.memory.memory_snapshot",
+                        lambda: marks)
+    r = HbmWatermarkGrowth()
+    assert r.evaluate({}) is None          # baseline anchors at 200MiB
+    marks["tpu:0"]["last_bytes_in_use"] = 400 * 1024 * 1024
+    assert r.evaluate({}) is None          # 2.0x: breach 1/3
+    assert r.evaluate({}) is None          # 2/3
+    d = r.evaluate({})
+    assert d["device"] == "tpu:0" and d["growth"] == pytest.approx(2.0)
+
+
+def test_hbm_growth_below_floor_is_host_noise(monkeypatch):
+    marks = {"cpu:0": {"last_bytes_in_use": 1024 * 1024}}
+    monkeypatch.setattr("mpi_blockchain_tpu.meshprof.memory.memory_snapshot",
+                        lambda: marks)
+    r = HbmWatermarkGrowth()
+    r.evaluate({})
+    marks["cpu:0"]["last_bytes_in_use"] = 10 * 1024 * 1024  # 10x, tiny
+    for _ in range(6):
+        assert r.evaluate({}) is None
+    assert not r.open
+
+
+def test_stale_rank_anchors_past_events_and_fires_on_new_ones():
+    emit_event({"event": "mesh_shrunk", "evicted": 9})   # pre-install
+    r = StaleRank()
+    assert r.evaluate({}) is None          # anchor: old damage ignored
+    assert r.evaluate({}) is None
+    emit_event({"event": "mesh_rank_failed", "rank": 2, "reason": "rc=2"})
+    d = r.evaluate({})                     # definitive: debounce 1
+    assert d == {"events": 1, "last_event": "mesh_rank_failed",
+                 "rank": 2, "reason": "rc=2"}
+    assert r.open
+    # Ring quiet: two clean samples close the episode.
+    r.evaluate({})
+    r.evaluate({})
+    assert not r.open
+
+
+def test_bubble_regression_fires_on_regression_not_weather(monkeypatch):
+    monkeypatch.setenv("MPIBT_CHAINWATCH_BUBBLE_WARMUP", "2")
+    rep = {"bubble_fraction": 0.2}
+    monkeypatch.setattr(
+        "mpi_blockchain_tpu.meshwatch.pipeline.pipeline_report",
+        lambda records: rep)
+    r = BubbleRegression()
+    now = 0.0
+    for _ in range(3):                     # warmup: baseline ~0.2
+        now += 1.0
+        assert r.evaluate({"now": now}) is None
+    rep = {"bubble_fraction": 0.4}         # within margin 0.3: weather
+    now += 1.0
+    assert r.evaluate({"now": now}) is None
+    assert r._breach_streak == 0
+    rep = {"bubble_fraction": 0.9}         # regression past the margin
+    fired = []
+    for _ in range(4):
+        now += 1.0
+        d = r.evaluate({"now": now})
+        if d is not None:
+            fired.append(d)
+    assert len(fired) == 1
+    assert fired[0]["bubble_fraction"] == pytest.approx(0.9)
+    assert fired[0]["margin"] == pytest.approx(0.3)
+
+
+def test_bubble_regression_throttles_to_min_interval(monkeypatch):
+    calls = []
+    monkeypatch.setattr(
+        "mpi_blockchain_tpu.meshwatch.pipeline.pipeline_report",
+        lambda records: calls.append(1) or {"bubble_fraction": 0.5})
+    r = BubbleRegression()
+    r.evaluate({"now": 10.0})
+    for _ in range(5):                     # same instant: held verdict
+        r.evaluate({"now": 10.0})
+    assert len(calls) == 1
+    r.evaluate({"now": 11.0})              # past min_interval: recompute
+    assert len(calls) == 2
+
+
+def test_event_storm_burst_and_window_expiry(monkeypatch):
+    monkeypatch.setenv("MPIBT_CHAINWATCH_STORM_N", "3")
+    monkeypatch.setenv("MPIBT_CHAINWATCH_STORM_WINDOW", "10")
+    r = EventStorm()
+    assert r.evaluate({"now": 0.0}) is None      # anchor
+    emit_event({"event": "retry", "site": "dispatch"})
+    emit_event({"event": "fault_injected", "site": "backend.cpu.search"})
+    assert r.evaluate({"now": 1.0}) is None      # 2 < storm_n
+    emit_event({"event": "collective_timeout", "site": "winner_select"})
+    d = r.evaluate({"now": 2.0})                 # 3 in window: fires
+    assert d["events"] == 3
+    assert d["kinds"] == {"collective_timeout": 1, "fault_injected": 1,
+                          "retry": 1}
+    assert r.open
+    # The burst ages out of the window: two clean samples close it.
+    assert r.evaluate({"now": 20.0}) is None
+    assert r.evaluate({"now": 21.0}) is None
+    assert not r.open
+    # Non-storm events never count.
+    emit_event({"event": "checkpoint_saved"})
+    emit_event({"event": "block_mined"})
+    emit_event({"event": "mesh_shrunk"})
+    assert r.evaluate({"now": 22.0}) is None
+    assert r._breach_streak == 0
+    assert "retry" in STORM_EVENTS and "block_mined" not in STORM_EVENTS
+
+
+# ---- the incident path -------------------------------------------------
+
+
+def test_emit_incident_signals_on_every_surface(tmp_path):
+    chainwatch.install(tmp_path / "inc")
+    rec = chainwatch.emit_incident(rule="event_storm", severity="warn",
+                                   detail={"events": 4}, heights=(7, 3),
+                                   source="test")
+    assert rec["incident_seq"] == 1 and rec["heights"] == [3, 7]
+    # 1. the counter, labeled by rule and severity.
+    snap = telemetry.default_registry().snapshot()
+    (m,) = snap["incidents_total"]
+    assert m["labels"] == {"rule": "event_storm", "severity": "warn"}
+    assert m["value"] == 1
+    # 2. the structured event on the ring.
+    (ev,) = [e for e in telemetry.recent_events()
+             if e.get("event") == "incident"]
+    assert ev["rule"] == "event_storm" and ev["severity"] == "warn"
+    # 3. the open-episode table.
+    (open_inc,) = chainwatch.open_incidents()
+    assert open_inc["rule"] == "event_storm"
+    # 4. the evidence bundle, schema-pinned.
+    path = pathlib.Path(rec["bundle"])
+    assert path.name == "incident_0001_event_storm.json"
+    bundle = json.loads(path.read_text())
+    assert set(bundle) == set(BUNDLE_KEYS)
+    assert bundle["artifact"] == "incident"
+    assert bundle["reason"] == "incident:event_storm"
+    assert bundle["heights"] == [3, 7]
+    chainwatch.close_incident("event_storm")
+    assert chainwatch.open_incidents() == []
+    # Closing is a live-view operation: counter and bundle remain.
+    assert path.exists()
+
+
+def test_bundle_rate_limit_and_cap(tmp_path, monkeypatch):
+    monkeypatch.setenv("MPIBT_CHAINWATCH_BUNDLE_CAP", "2")
+    chainwatch.install(tmp_path)
+    a = chainwatch.emit_incident(rule="a", severity="warn")
+    b = chainwatch.emit_incident(rule="a", severity="warn")
+    assert "bundle" in a and "bundle" not in b   # per-rule rate limit
+    c = chainwatch.emit_incident(rule="b", severity="critical")
+    assert "bundle" in c                         # distinct rule: allowed
+    d = chainwatch.emit_incident(rule="c", severity="warn")
+    assert "bundle" not in d                     # process cap reached
+    assert len(list(tmp_path.glob("incident_*.json"))) == 2
+    # The open table keeps ONE entry per rule (episode replacement).
+    assert sorted(i["rule"] for i in chainwatch.open_incidents()) \
+        == ["a", "b", "c"]
+    assert cw_incident.incident_count() == 4
+
+
+def test_incidents_without_directory_still_signal():
+    chainwatch.install()                         # no bundle dir
+    rec = chainwatch.emit_incident(rule="x", severity="warn")
+    assert "bundle" not in rec
+    assert chainwatch.open_incidents()
+    assert "incidents_total" in telemetry.default_registry().snapshot()
+
+
+def test_build_bundle_filters_blocktrace_to_implicated_heights():
+    from mpi_blockchain_tpu.meshwatch.pipeline import profiler
+
+    chainwatch.install()
+    p = profiler()
+    for h in (1, 2, 3):
+        p.dispatch(kind="sweep", height=h, backend="cpu")
+    bundle = build_bundle({"rule": "r", "severity": "warn", "detail": {},
+                           "heights": (2,), "incident_seq": 1,
+                           "opened_at": time.time()})
+    assert set(bundle) == set(BUNDLE_KEYS)
+    assert [r["meta"]["height"] for r in bundle["blocktrace"]] == [2]
+    # No match: the whole tail rides along (evidence beats emptiness).
+    bundle = build_bundle({"rule": "r", "severity": "warn", "detail": {},
+                           "heights": (99,), "incident_seq": 2,
+                           "opened_at": time.time()})
+    assert len(bundle["blocktrace"]) == 3
+
+
+def test_bundle_carries_mesh_membership():
+    chainwatch.install()
+    chainwatch.notify_mesh({"live": [0, 1, 3], "evicted": [2],
+                            "reason": "stale"})
+    bundle = build_bundle({"rule": "stale_rank", "severity": "critical",
+                           "detail": {}, "heights": (), "incident_seq": 1,
+                           "opened_at": time.time()})
+    assert bundle["mesh"] == {"live": [0, 1, 3], "evicted": [2],
+                              "reason": "stale"}
+
+
+# ---- evaluate seams ----------------------------------------------------
+
+
+def test_evaluate_fires_and_holds_episode(tmp_path, monkeypatch):
+    monkeypatch.setenv("MPIBT_CHAINWATCH_STORM_N", "2")
+    chainwatch.install(tmp_path)
+    assert chainwatch.evaluate(force=True) == []     # anchor sweep
+    emit_event({"event": "retry", "site": "dispatch"})
+    emit_event({"event": "retry", "site": "dispatch"})
+    fired = chainwatch.evaluate(height=5, source="block", force=True)
+    assert [f["rule"] for f in fired] == ["event_storm"]
+    assert fired[0]["heights"] == [5] and fired[0]["source"] == "block"
+    # The open episode never re-fires while the burst is in-window.
+    assert chainwatch.evaluate(force=True) == []
+    assert [i["rule"] for i in chainwatch.open_incidents()] \
+        == ["event_storm"]
+
+
+def test_evaluate_disarmed_and_telemetry_off_are_noops(tmp_path):
+    # Disarmed: nothing, not even rule construction.
+    assert chainwatch.evaluate(force=True) == []
+    assert not chainwatch.installed()
+    # Armed but killed: the flag check wins — no rule sees a sample.
+    chainwatch.install(tmp_path)
+    probe = _Toggle()
+    probe.breach = True
+    chainwatch._rules.append(probe)
+    set_telemetry_disabled(True)
+    for _ in range(5):
+        assert chainwatch.evaluate(force=True) == []
+    assert probe.samples == 0
+    assert chainwatch.notify_eviction(2, "stale") is None
+    assert chainwatch.open_incidents() == []
+    # Kill switch released: the same rules run again.
+    set_telemetry_disabled(False)
+    chainwatch.evaluate(force=True)
+    assert probe.samples == 1
+
+
+def test_evaluate_throttle_bounds_sweep_rate(monkeypatch):
+    monkeypatch.setenv("MPIBT_CHAINWATCH_INTERVAL", "3600")
+    chainwatch.install()
+    probe = _Toggle()
+    chainwatch._rules.append(probe)
+    chainwatch.evaluate()                  # first sweep stamps the clock
+    first = probe.samples
+    for _ in range(10):
+        chainwatch.evaluate()              # throttled: clock read only
+    assert probe.samples == first
+    chainwatch.evaluate(force=True)        # flush cadence bypasses
+    assert probe.samples == first + 1
+
+
+def test_broken_rule_never_hurts_the_run(tmp_path):
+    chainwatch.install(tmp_path)
+
+    class _Broken(Rule):
+        name = "broken"
+
+        def sample(self, ctx):
+            raise RuntimeError("detector bug")
+
+    chainwatch._rules.insert(0, _Broken())
+    assert chainwatch.evaluate(force=True) == []    # swallowed, others ran
+
+
+def test_notify_eviction_fires_stale_rank_once(tmp_path):
+    chainwatch.install(tmp_path)
+    rec = chainwatch.notify_eviction(2, "stale", height=7, live=[0, 1, 3])
+    assert rec["rule"] == "stale_rank" and rec["severity"] == "critical"
+    assert rec["detail"]["rank"] == 2 and rec["heights"] == [7]
+    assert [i["rule"] for i in chainwatch.open_incidents()] \
+        == ["stale_rank"]
+    # The same episode never fires twice.
+    assert chainwatch.notify_eviction(3, "stale", height=8) is None
+    bundle = json.loads(
+        pathlib.Path(rec["bundle"]).read_text())
+    assert bundle["mesh"]["live"] == [0, 1, 3]
+    assert bundle["mesh"]["evicted"] == [2]
+
+
+def test_elastic_evict_reaches_chainwatch(tmp_path):
+    from mpi_blockchain_tpu.resilience.elastic import ElasticWorld
+
+    chainwatch.install(tmp_path)
+    chainwatch.evaluate(force=True)
+    world = ElasticWorld(rank=0, world_size=4)
+    assert world.evict(2, "stale", height=5)
+    (inc,) = chainwatch.open_incidents()
+    assert inc["rule"] == "stale_rank" and inc["source"] == "eviction"
+    assert inc["detail"]["rank"] == 2
+
+
+def test_install_uninstall_lifecycle(tmp_path):
+    chainwatch.install(tmp_path)
+    chainwatch.emit_incident(rule="x", severity="warn")
+    assert chainwatch.installed() and chainwatch.open_incidents()
+    chainwatch.uninstall()
+    assert not chainwatch.installed()
+    assert chainwatch.open_incidents() == []
+    assert cw_incident.incident_count() == 0
+    # Re-install: fresh rules, fresh seq.
+    chainwatch.install()
+    rec = chainwatch.emit_incident(rule="y", severity="warn")
+    assert rec["incident_seq"] == 1
+
+
+# ---- the false-positive contract ---------------------------------------
+
+
+@pytest.mark.parametrize("pipeline", [False, True],
+                         ids=["sequential", "pipelined"])
+@pytest.mark.parametrize("node_id", [0, 1, 2])
+def test_clean_fixed_seed_mine_zero_incidents(tmp_path, pipeline, node_id):
+    """A clean cpu mine must NEVER fire: every rule errs quiet. Three
+    header seeds (node ids) x both drivers, with the watchdog armed and
+    evaluating on the real per-block cadence."""
+    from mpi_blockchain_tpu.config import MinerConfig
+    from mpi_blockchain_tpu.models.miner import Miner
+
+    inc_dir = tmp_path / "inc"
+    chainwatch.install(inc_dir)
+    m = Miner(MinerConfig(difficulty_bits=8, n_blocks=6, batch_pow2=10,
+                          backend="cpu"),
+              node_id=node_id, pipeline=pipeline)
+    records = m.mine_chain()
+    assert len(records) == 6
+    # One final full sweep, then the pins: no incident anywhere.
+    assert chainwatch.evaluate(force=True) == []
+    assert chainwatch.open_incidents() == []
+    assert cw_incident.incident_count() == 0
+    assert not list(inc_dir.glob("*.json"))
+    assert "incidents_total" not in telemetry.default_registry().snapshot()
+    assert not [e for e in telemetry.recent_events()
+                if e.get("event") == "incident"]
+
+
+# ---- flight recorder: the shared snapshot body -------------------------
+
+
+def test_crash_dump_is_snapshot_plus_prior_reasons(tmp_path):
+    telemetry.counter("hashes_tried_total", backend="cpu").inc(5)
+    flight_recorder.register_context(seed=7)
+    path = tmp_path / "fr.json"
+    flight_recorder.install(path, last_n=32)
+    assert flight_recorder.dump_now("advisory: watchdog fired") == path
+    dump = json.loads(path.read_text())
+    snap = flight_recorder.snapshot("advisory: watchdog fired")
+    # The crash artifact IS the shared snapshot body + prior_reasons —
+    # byte-equivalent modulo the volatile stamps.
+    assert set(dump) == set(snap) | {"prior_reasons"}
+    for key in ("artifact", "reason", "pid", "argv", "context",
+                "metrics", "causal"):
+        assert dump[key] == json.loads(json.dumps(snap[key],
+                                                  default=str)), key
+    assert dump["prior_reasons"] == []
+    assert dump["context"] == {"seed": 7}
+
+
+def test_snapshot_defaults_to_installed_tail_bound(tmp_path):
+    for i in range(50):
+        emit_event({"event": "retry", "i": i})
+    flight_recorder.install(tmp_path / "fr.json", last_n=8)
+    assert len(flight_recorder.snapshot("x")["events"]) == 8
+    assert len(flight_recorder.snapshot("x", last_n=3)["events"]) == 3
+
+
+def test_double_dump_guard_skips_reentrant_write(tmp_path):
+    path = tmp_path / "fr.json"
+    flight_recorder.install(path)
+    with flight_recorder._lock:
+        flight_recorder._state["dumping"] = True
+    try:
+        assert flight_recorder.dump_now("overlap") is None
+        assert not path.exists()
+    finally:
+        with flight_recorder._lock:
+            flight_recorder._state["dumping"] = False
+    assert flight_recorder.dump_now("after") == path
+
+
+def test_artifact_cap_bounds_a_flapping_watchdog(tmp_path):
+    path = tmp_path / "fr.json"
+    flight_recorder.install(path)
+    written = [flight_recorder.dump_now(f"advisory {i}")
+               for i in range(flight_recorder.DUMP_CAP + 5)]
+    assert written.count(path) == flight_recorder.DUMP_CAP
+    assert all(p is None for p in written[flight_recorder.DUMP_CAP:])
+    # The LAST successful dump carries every prior reason (overwrite
+    # semantics unchanged by the cap).
+    dump = json.loads(path.read_text())
+    assert len(dump["prior_reasons"]) == flight_recorder.DUMP_CAP - 1
+    # Re-install resets the cap accounting.
+    flight_recorder.install(path)
+    assert flight_recorder.dump_now("fresh") == path
+
+
+def test_failed_write_never_latches_dumped(tmp_path):
+    flight_recorder.install(tmp_path / "missing_dir" / "fr.json")
+    assert flight_recorder.dump_now("x") is None
+    with flight_recorder._lock:
+        assert flight_recorder._state["dumped"] is False
+        assert flight_recorder._state["dump_count"] == 0
+
+
+# ---- the Perfetto incident lane ----------------------------------------
+
+
+def test_trace_export_incident_lane():
+    from mpi_blockchain_tpu.blocktrace.critical_path import \
+        critical_path_report
+    from mpi_blockchain_tpu.blocktrace.export import (INCIDENT_PID,
+                                                      to_critical_path_trace)
+
+    now = time.time()
+    incidents = [{"rule": "event_storm", "severity": "warn",
+                  "incident_seq": 1, "opened_at": now + 0.5,
+                  "heights": [3], "rank": 2},
+                 {"rule": "hashrate_collapse", "severity": "critical",
+                  "incident_seq": 2, "opened_at": now + 1.0, "rank": 0}]
+    trace = to_critical_path_trace(critical_path_report([]), [],
+                                   incidents=incidents)
+    lane = [e for e in trace["traceEvents"] if e.get("pid") == INCIDENT_PID]
+    names = {e["name"] for e in lane if e["ph"] == "i"}
+    assert names == {"incident:event_storm", "incident:hashrate_collapse"}
+    (storm,) = [e for e in lane if e.get("name") == "incident:event_storm"]
+    assert storm["s"] == "p" and storm["args"]["rank"] == 2
+    assert storm["args"]["heights"] == [3]
+    # Markers sit on the shared wall axis (epoch anchored).
+    assert trace["metadata"]["epoch_unix_s"] == pytest.approx(now + 0.5)
+    # No incidents: no lane.
+    trace = to_critical_path_trace(critical_path_report([]), [])
+    assert not [e for e in trace["traceEvents"]
+                if e.get("pid") == INCIDENT_PID]
+
+
+# ---- the audit prices rule evaluation ----------------------------------
+
+
+def test_overhead_audit_arms_chainwatch_and_restores():
+    from mpi_blockchain_tpu.blocktrace.overhead import measure_block_observe
+
+    assert not chainwatch.installed()
+    out = measure_block_observe(samples=8, chunk_pow2=8)
+    assert out["block_observe_us"] > 0
+    assert not chainwatch.installed()      # audit disarms on the way out

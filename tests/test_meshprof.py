@@ -342,7 +342,8 @@ def test_shard_payload_carries_skew_spans_and_memory(tmp_path):
 
 def test_mesh_health_payload_schema_pin():
     """The /healthz schema: every pre-existing key unchanged, plus the
-    additive meshprof `skew` and `memory` fields."""
+    additive meshprof `skew`/`memory` and chainwatch `incidents`
+    fields."""
     spans0 = [span("block.step", i, 1000.0 + i) for i in range(3)]
     spans1 = [span("block.step", i, 1000.0 + i + 0.002 * (i % 2))
               for i in range(3)]
@@ -358,7 +359,8 @@ def test_mesh_health_payload_schema_pin():
     assert set(health) == {"status", "healthy", "world_size", "stall_s",
                            "heartbeat_stall_s", "live_ranks",
                            "stale_ranks", "failed_ranks", "missing_ranks",
-                           "ranks", "skew", "memory"}
+                           "ranks", "skew", "memory", "incidents"}
+    assert health["incidents"] == []
     assert health["skew"]["sites"]["block.step"]["straggler_rank"] == 1
     assert health["memory"] == {"0": {"dev0": {"bytes_in_use": 7}}}
 

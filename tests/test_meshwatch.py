@@ -648,6 +648,114 @@ def test_mesh_server_endpoints(tmp_path):
         srv.close()
 
 
+# ---- chainwatch incident carriage --------------------------------------
+
+
+#: The pre-chainwatch /healthz schema: the `incidents` key is ADDITIVE —
+#: these keys (and their shapes) must survive any chainwatch change.
+HEALTHZ_BASE_KEYS = {
+    "status", "healthy", "world_size", "stall_s", "heartbeat_stall_s",
+    "live_ranks", "stale_ranks", "failed_ranks", "missing_ranks",
+    "ranks", "skew", "memory",
+}
+
+
+def test_mesh_health_incidents_key_is_additive(tmp_path):
+    # Shards written before chainwatch existed carry no `incidents` key:
+    # the aggregate must still emit the key (empty) while every
+    # pre-existing key keeps its shape — the additive schema pin.
+    code, health = mesh_health(
+        tmp_path, stall_s=5.0,
+        shards=[_shard(0, final=False), _shard(1, final=False)])
+    assert code == 200
+    assert HEALTHZ_BASE_KEYS <= set(health)
+    assert health["incidents"] == []
+    # The no-shards degenerate payload carries the key too.
+    _, empty = mesh_health(tmp_path / "void", stall_s=5.0)
+    assert empty["incidents"] == []
+    assert (HEALTHZ_BASE_KEYS
+            - {"stall_s", "heartbeat_stall_s"}) <= set(empty)
+
+
+def test_mesh_health_carries_rank_stamped_incidents(tmp_path):
+    inc = {"rule": "event_storm", "severity": "warn", "detail": {},
+           "heights": [4], "incident_seq": 1,
+           "opened_at": time.time(), "source": "flush"}
+    shards = [_shard(0, final=False),
+              {**_shard(1, final=False), "incidents": [inc]}]
+    code, health = mesh_health(tmp_path, stall_s=5.0, shards=shards)
+    assert code == 200                      # open incident != stale rank
+    (got,) = health["incidents"]
+    assert got == {**inc, "rank": 1}
+
+
+def test_mesh_incidents_orders_and_filters():
+    from mpi_blockchain_tpu.meshwatch.aggregate import mesh_incidents
+
+    shards = [
+        {**_shard(2, final=False),
+         "incidents": [{"rule": "b", "incident_seq": 2},
+                       {"rule": "a", "incident_seq": 1}]},
+        {**_shard(0, final=False),
+         "incidents": [{"rule": "c", "incident_seq": 9},
+                       "torn", None]},     # non-dict entries skipped
+        _shard(1, final=False),            # pre-chainwatch shard: no key
+    ]
+    out = mesh_incidents(shards)
+    assert [(i["rank"], i["rule"]) for i in out] \
+        == [(0, "c"), (2, "a"), (2, "b")]
+
+
+def test_shard_payload_carries_open_incidents(tmp_path):
+    from mpi_blockchain_tpu import chainwatch
+
+    w = ShardWriter(tmp_path, rank=0, world_size=1)
+    assert w.payload()["incidents"] == []   # disarmed: same carriage, []
+    chainwatch.install()
+    try:
+        chainwatch.emit_incident(rule="event_storm", severity="warn",
+                                 heights=(3,), source="test")
+        (inc,) = w.payload()["incidents"]
+        assert inc["rule"] == "event_storm" and inc["heights"] == [3]
+    finally:
+        chainwatch.uninstall()
+
+
+def test_mesh_server_incidents_endpoint(tmp_path):
+    import urllib.request
+
+    from mpi_blockchain_tpu.meshwatch.server import MeshServer
+
+    inc = {"rule": "hbm_watermark_growth", "severity": "warn",
+           "detail": {"device": "tpu:0"}, "heights": [],
+           "incident_seq": 3, "opened_at": time.time(), "source": "flush"}
+    shard_path(tmp_path, 0).parent.mkdir(parents=True, exist_ok=True)
+    shard_path(tmp_path, 0).write_text(json.dumps(_shard(0, final=False)))
+    shard_path(tmp_path, 1).write_text(
+        json.dumps({**_shard(1, final=False), "incidents": [inc]}))
+    srv = MeshServer(tmp_path, port=0)
+    try:
+        srv.start()
+        with urllib.request.urlopen(srv.url("/incidents"), timeout=10) as r:
+            assert r.status == 200
+            doc = json.loads(r.read())
+        assert doc["count"] == 1
+        assert doc["incidents"] == [{**inc, "rank": 1}]
+        # /healthz mirrors the same list under its additive key.
+        with urllib.request.urlopen(srv.url("/healthz"), timeout=10) as r:
+            health = json.loads(r.read())
+        assert health["incidents"] == [{**inc, "rank": 1}]
+        # The 404 catalogue advertises the endpoint.
+        try:
+            urllib.request.urlopen(srv.url("/nope"), timeout=10)
+        except urllib.error.HTTPError as e:
+            assert "/incidents" in json.loads(e.read())["endpoints"]
+        else:
+            raise AssertionError("404 expected")
+    finally:
+        srv.close()
+
+
 # ---- multi-rank acceptance ---------------------------------------------
 
 
