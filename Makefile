@@ -8,12 +8,13 @@ SHELL := /bin/bash   # tier1 uses pipefail/PIPESTATUS
         metrics-smoke forensics-smoke \
         perf-smoke chaos-smoke adversary-smoke meshwatch-smoke \
         elastic-smoke trace-smoke pipeline-smoke skew-smoke \
-        incident-smoke tier1 core clean
+        incident-smoke compile-smoke tier1 core clean
 
 check: lint opbudget-check shardbudget-check metrics-smoke \
         forensics-smoke perf-smoke \
         chaos-smoke adversary-smoke meshwatch-smoke elastic-smoke \
-        trace-smoke pipeline-smoke skew-smoke incident-smoke tier1
+        trace-smoke pipeline-smoke skew-smoke incident-smoke \
+        compile-smoke tier1
 
 # chainlint: binding contract, header layout, JAX purity, sanitizer
 # matrix, thread races (CONC), SPMD collectives, hot-path blocking,
@@ -211,6 +212,17 @@ incident-smoke:
 	env JAX_PLATFORMS=cpu $(PY) -m mpi_blockchain_tpu.chainwatch smoke \
 	    2>/dev/null || { echo "incident-smoke: failed"; exit 1; }; \
 	echo "incident-smoke: ok"
+
+# Compile smoke: the dispatchwatch gate — a fixed-seed two-leg cpu mine
+# (sequential + pipelined, chains byte-identical) must compile each
+# sweep callable exactly once (per-site compiles == cache entries),
+# zero post-warmup recompiles, zero recompile_storm incidents, and a
+# complete measured-vs-committed cost join; the recompiles_after_warmup
+# headline is gated at the compile_cache absolute bound (0.0).
+compile-smoke:
+	env JAX_PLATFORMS=cpu $(PY) -m mpi_blockchain_tpu.dispatchwatch \
+	    smoke 2>/dev/null || { echo "compile-smoke: failed"; exit 1; }; \
+	echo "compile-smoke: ok"
 
 # Tier-1 verify, verbatim from ROADMAP.md.
 tier1:

@@ -55,7 +55,8 @@ OVERRIDE_KEYS = ("capi", "ctypes_binding", "pybind", "chain_hpp",
                  "lock_files", "future_files", "thread_files",
                  "wait_files", "waitbudget_json",
                  "shard_files", "shardbudget_json",
-                 "skew_scope_files", "incident_scope_files")
+                 "skew_scope_files", "incident_scope_files",
+                 "compile_scope_files")
 
 
 def _changed_files(root: pathlib.Path, rev: str) -> list[str] | None:

@@ -64,6 +64,20 @@ a future edit that emits a bus event through the raw JSON-lines stream
           keyword-only; the lint catches the refactor that loosens it,
           same stance as TEL005's site=).
 
+  TEL007  a dispatchwatch compile emit point (``compile_scope(...)`` /
+          ``note_cache(...)``) that does not carry a ``site=`` keyword.
+          The compile census joins observed XLA compiles to the seam
+          cache that should have absorbed them on the site label — a
+          compile attributed without one lands as ``unscoped`` noise
+          the recompile accounting must price pessimistically, and a
+          cache note without one prices nothing at all (the runtime
+          spells both parameters keyword-only; the lint catches the
+          refactor that loosens it — the same stance as TEL005's
+          skew-span site, and the runtime twin of shardlint SHD003's
+          divergent-trace gate: SHD003 proves per-rank traces agree
+          statically, TEL007 keeps the runtime evidence attributable
+          when they don't).
+
 Scope: TEL001 over ``mpi_blockchain_tpu/simulation.py`` (the bus
 surface; override key ``sim_py``); TEL002 over every ``.py`` in the
 package (override key ``telemetry_files`` — the drift-fixture seam);
@@ -78,7 +92,11 @@ mining loop plus the CLI seam — ``models/miner.py``, ``models/fused.py``,
 ``blocktrace/overhead.py`` (override key ``skew_scope_files``); TEL006
 over the incident emit surface — ``chainwatch/`` plus the wired seams
 ``resilience/elastic.py``, ``blocktrace/critical_path.py``,
-``meshwatch/shard.py`` (override key ``incident_scope_files``).
+``meshwatch/shard.py`` (override key ``incident_scope_files``); TEL007
+over the compile emit surface — ``dispatchwatch/`` plus the wired
+dispatch seams ``backend/tpu.py``, ``models/fused.py``,
+``parallel/mesh.py``, ``blocktrace/overhead.py`` (override key
+``compile_scope_files``).
 """
 from __future__ import annotations
 
@@ -369,6 +387,61 @@ def _run_incident_lint(root: pathlib.Path, files) -> list[Finding]:
     return findings
 
 
+def _compile_scope_files(root: pathlib.Path) -> list[pathlib.Path]:
+    """TEL007's surface: everywhere a compile emit is born — the
+    subsystem itself plus the wired dispatch seams (missing files are
+    skipped, matching the other scope builders)."""
+    pkg = root / "mpi_blockchain_tpu"
+    files = [p for p in (pkg / "backend" / "tpu.py",
+                         pkg / "models" / "fused.py",
+                         pkg / "parallel" / "mesh.py",
+                         pkg / "blocktrace" / "overhead.py")
+             if p.is_file()]
+    d = pkg / "dispatchwatch"
+    if d.is_dir():
+        files.extend(p for p in d.rglob("*.py")
+                     if "__pycache__" not in p.parts)
+    return sorted(files)
+
+
+def _run_compile_emit_lint(root: pathlib.Path, files) -> list[Finding]:
+    """TEL007: every ``compile_scope(...)`` / ``note_cache(...)`` emit
+    point carries a ``site=`` keyword (a ``**`` spread is opaque and
+    passes — the call site owns it, same stance as TEL005's site)."""
+    findings: list[Finding] = []
+    for path in files:
+        rel = rel_path(path, root)
+        try:
+            tree = ast.parse(path.read_text(), filename=str(path))
+        except SyntaxError as e:
+            findings.append(Finding(rel, e.lineno or 1, "TEL000",
+                                    f"syntax error: {e.msg}"))
+            continue
+        except OSError:
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _call_name(node)
+            # Suffix match for aliased imports (`from ... import
+            # compile_scope as _compile_scope`), same stance as TEL005.
+            if not (name and (name.endswith("compile_scope")
+                              or name.endswith("note_cache"))):
+                continue
+            if not any(kw.arg in ("site", None) for kw in node.keywords):
+                emit = ("compile_scope" if name.endswith("compile_scope")
+                        else "note_cache")
+                findings.append(Finding(
+                    rel, node.lineno, "TEL007",
+                    f"{emit}() without site= — the compile census joins "
+                    f"observed XLA compiles to the seam cache that "
+                    f"should have absorbed them on the site label, so "
+                    f"this emit lands as unscoped/unpriceable noise; "
+                    f"pass site=... at the emit point — "
+                    f"docs/observability.md §dispatchwatch"))
+    return findings
+
+
 def _run_rank_label_lint(root: pathlib.Path, files) -> list[Finding]:
     """TEL003: no hand-rolled ``rank=`` label on a raw registry call in
     multi-rank code."""
@@ -418,6 +491,9 @@ def run_telemetry_lint(root: pathlib.Path, overrides=None,
     incident_files = override_files(overrides, "incident_scope_files",
                                     lambda: _incident_scope_files(root))
     findings.extend(_run_incident_lint(root, incident_files))
+    compile_files = override_files(overrides, "compile_scope_files",
+                                   lambda: _compile_scope_files(root))
+    findings.extend(_run_compile_emit_lint(root, compile_files))
     sim_py = overrides.get(
         "sim_py", root / "mpi_blockchain_tpu" / "simulation.py")
     rel = rel_path(sim_py, root)

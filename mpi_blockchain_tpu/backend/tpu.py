@@ -26,6 +26,7 @@ from __future__ import annotations
 import numpy as np
 
 from .. import core
+from ..dispatchwatch import compile_scope, note_cache
 from ..resilience import injection
 from ..telemetry import counter
 from ..telemetry.spans import span
@@ -82,6 +83,7 @@ class TpuBackend(MinerBackend):
                 self.batch_size, difficulty_bits, n_miners=self.n_miners,
                 mesh=self.mesh, kernel=self.kernel)
             self._searchers[difficulty_bits] = fn
+            note_cache(site="backend.tpu", entries=len(self._searchers))
         return fn
 
     # ---- the plugin contract ---------------------------------------------
@@ -132,7 +134,8 @@ class TpuBackend(MinerBackend):
             # — the device-side share of the search (vs the CPU tail's
             # host share), the split docs/observability.md documents.
             with span("backend.tpu.dispatch",
-                      difficulty=difficulty_bits, n_rounds=n_rounds):
+                      difficulty=difficulty_bits, n_rounds=n_rounds), \
+                    compile_scope(site="backend.tpu"):
                 out = self._searcher(difficulty_bits)(
                     ext, np.uint32(base), np.uint32(n_rounds))
                 rounds, count, min_nonce = (

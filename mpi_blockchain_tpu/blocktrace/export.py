@@ -21,6 +21,12 @@ one instant marker per incident at its ``opened_at`` wall time, named
 ``incident:<rule>`` — scrub to the marker and the surrounding pipeline /
 collective /critical-path slices ARE the evidence window the incident
 bundle snapshotted.
+
+When per-rank ``compiles`` (dispatchwatch compile events, as carried by
+the shard ``compiles`` key) are passed, an **xla compiles** process row
+is added: one slice per observed backend compile, named
+``compile:<site>`` — a compile slice overlapping a mining dispatch on
+the same wall axis is a recompile stealing device time from the sweep.
 """
 from __future__ import annotations
 
@@ -32,6 +38,8 @@ CRITICAL_PID = 999999
 COLLECTIVE_PID = 999998
 #: The chainwatch incident-annotation row's pid — under the collectives.
 INCIDENT_PID = 999997
+#: The dispatchwatch XLA-compile row's pid — under the incidents.
+COMPILE_PID = 999996
 
 
 def _collective_lane(events: list, skew_spans: dict, epoch: float) -> None:
@@ -90,15 +98,47 @@ def _incident_lane(events: list, incidents: list, epoch: float) -> None:
         })
 
 
+def _compile_lane(events: list, compiles: dict, epoch: float) -> None:
+    """Append the XLA-compile process row: tid = rank, one ``ph: X``
+    slice per observed backend compile. A compile event's ``t`` stamp
+    is its END (the listener reports a completed duration), so the
+    slice opens ``ms`` earlier."""
+    events.append({"ph": "M", "name": "process_name",
+                   "pid": COMPILE_PID, "tid": 0,
+                   "args": {"name": "xla compiles"}})
+    for rank in sorted(compiles, key=int):
+        events.append({"ph": "M", "name": "thread_name",
+                       "pid": COMPILE_PID, "tid": int(rank),
+                       "args": {"name": f"rank {rank}"}})
+        for rec in compiles[rank]:
+            try:
+                ms = float(rec["ms"])
+                ts = (float(rec["t"]) - epoch) * 1e6 - ms * 1e3
+                site = str(rec["site"])
+            except (KeyError, TypeError, ValueError):
+                continue
+            events.append({
+                "ph": "X", "cat": "compile", "name": f"compile:{site}",
+                "pid": COMPILE_PID, "tid": int(rank),
+                "ts": round(ts, 3), "dur": round(max(ms * 1e3, 1e-1), 3),
+                "args": {"site": site, "ms": ms,
+                         "stage": rec.get("stage", "backend_compile")},
+            })
+
+
 def to_critical_path_trace(report: dict, records: list[dict],
                            skew_spans: dict | None = None,
-                           incidents: list | None = None) -> dict:
+                           incidents: list | None = None,
+                           compiles: dict | None = None) -> dict:
     """Chrome trace-event JSON: base pipeline rows + the critical-path
     row (+ the collective lane when per-rank ``skew_spans`` — a mapping
     rank -> span list, as carried by meshwatch shards — are passed,
     + the incident annotation lane when chainwatch ``incidents`` —
-    rank-stamped records as served by ``/incidents`` — are passed).
-    Deterministic for a deterministic (report, records) pair."""
+    rank-stamped records as served by ``/incidents`` — are passed,
+    + the xla-compile lane when per-rank ``compiles`` — a mapping
+    rank -> compile-event list, as carried by the shard ``compiles``
+    key — are passed). Deterministic for a deterministic
+    (report, records) pair."""
     trace = to_chrome_trace(records)
     events = trace["traceEvents"]
     epoch = trace.get("metadata", {}).get("epoch_unix_s")
@@ -120,6 +160,16 @@ def to_critical_path_trace(report: dict, records: list[dict],
             lane_epoch = lane_epoch if lane_epoch is not None \
                 else min(opened)
             _incident_lane(events, incidents, lane_epoch)
+            trace.setdefault("metadata", {}).setdefault(
+                "epoch_unix_s", lane_epoch)
+    if compiles:
+        ends = [float(r["t"]) for recs in compiles.values()
+                for r in recs if r.get("t") is not None]
+        if ends:
+            lane_epoch = trace.get("metadata", {}).get("epoch_unix_s")
+            lane_epoch = lane_epoch if lane_epoch is not None \
+                else min(ends)
+            _compile_lane(events, compiles, lane_epoch)
             trace.setdefault("metadata", {}).setdefault(
                 "epoch_unix_s", lane_epoch)
     if epoch is None:       # no segments at all: nothing to highlight

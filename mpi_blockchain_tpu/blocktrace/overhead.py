@@ -46,6 +46,7 @@ import statistics
 import time
 
 from .. import chainwatch, core
+from ..dispatchwatch import compile_scope, note_cache
 from ..meshprof.spans import skew_span
 from ..telemetry import counter, heartbeat, set_telemetry_disabled
 from ..telemetry.spans import span
@@ -85,6 +86,18 @@ def _instrumented_round(profiler, height: int, base: int, chunk: int):
         # same paired audit — the off half pays only its flag check.
         with skew_span(site="trace-audit"):
             pass
+        # The dispatchwatch emit points, priced the same way: the scope
+        # is the per-dispatch cost every wired seam pays (arm check +
+        # tls push/pop; the off half pays one flag check in __init__).
+        # The cache note is a per-cache-MISS emit — a steady-state
+        # round pays none — so it is priced once, on the first round,
+        # matching the wired seams' cadence. No jax here, so
+        # ensure_listener stays a sys.modules miss — exactly the
+        # cold-backend fast path.
+        with compile_scope(site="trace-audit"):
+            pass
+        if height <= 1:
+            note_cache(site="trace-audit", entries=1)
         # The chainwatch watchdog step — the newest per-round emit
         # point: rule evaluation rides the same audit so the ≤3% gate
         # prices the live SLO rules too. The off half pays only the

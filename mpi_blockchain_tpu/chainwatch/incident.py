@@ -43,6 +43,7 @@ BUNDLE_KEYS = (
     # incident overlay
     "rule", "severity", "detail", "heights", "incident_seq",
     "opened_at", "blocktrace", "skew_spans", "memory", "mesh",
+    "compiles",
 )
 
 #: Bounded tails carried by a bundle (events/causal/spans come from
@@ -179,6 +180,7 @@ def build_bundle(record: dict) -> dict:
     """The bundle payload: ``flight_recorder.snapshot()`` (the shared
     evidence body) overlaid with the incident record and its extras.
     Pure builder — no I/O — so tests can pin the schema directly."""
+    from ..dispatchwatch import compile_snapshot
     from ..meshprof.memory import memory_snapshot
     from ..meshprof.spans import SKEW_TAIL_N, spans_tail
     from ..meshwatch.pipeline import profiler
@@ -212,5 +214,6 @@ def build_bundle(record: dict) -> dict:
         "mesh": dict(mesh) if mesh else {"rank": mesh_rank(),
                                          "world_size": int(os.environ.get(
                                              "MPIBT_MESH_WORLD", 1))},
+        "compiles": compile_snapshot(),
     })
     return payload

@@ -377,9 +377,55 @@ class EventStorm(Rule):
                       "kinds": dict(sorted(kinds.items()))}
 
 
+class RecompileStorm(Rule):
+    """Trace-cache churn off the dispatchwatch census: total observed
+    XLA backend compiles *growing* after the warmup samples breaches.
+    A healthy steady-state run compiles each sweep callable exactly
+    once during warmup and never again — post-warmup growth means some
+    dispatch seam is re-tracing (shape drift, a donated-buffer layout
+    flip, per-template retraces), the runtime twin of the SHD003
+    divergent-trace hang class. The first ``warmup_n`` samples absorb
+    legitimate startup compilation; ``allowed`` compiles per sample are
+    tolerated after that (default 0 — any growth is churn). Processes
+    that never observed a compile sample ``{}`` and never fire; the
+    incident detail carries the per-site census so the bundle names
+    the guilty seam."""
+
+    name = "recompile_storm"
+    severity = "warn"
+
+    def __init__(self):
+        super().__init__()
+        self.warmup_n = env_number("MPIBT_CHAINWATCH_RECOMPILE_WARMUP", 4,
+                                   cast=int, minimum=1)
+        self.allowed = env_number("MPIBT_CHAINWATCH_RECOMPILE_ALLOWED", 0,
+                                  cast=int, minimum=0)
+        self._prev_total = None
+        self._samples = 0
+
+    def sample(self, ctx):
+        from ..dispatchwatch import compile_census
+
+        census = compile_census()
+        if not census:
+            return False, {}
+        total = sum(int(st.get("compiles", 0)) for st in census.values())
+        prev, self._prev_total = self._prev_total, total
+        if prev is None:
+            return False, {}
+        self._samples += 1
+        grown = total - prev
+        if self._samples <= self.warmup_n or grown <= self.allowed:
+            return False, {}
+        return True, {"compiles_total": total, "grown": grown,
+                      "allowed": self.allowed,
+                      "sites": {site: int(st.get("compiles", 0))
+                                for site, st in census.items()}}
+
+
 def default_rules() -> list[Rule]:
     """Fresh instances of the full catalogue, evaluation order fixed
     (docs/observability.md §chainwatch documents each row)."""
     return [HashrateCollapse(), CollectiveSkewSpike(),
             HbmWatermarkGrowth(), StaleRank(), BubbleRegression(),
-            EventStorm()]
+            EventStorm(), RecompileStorm()]

@@ -1,0 +1,272 @@
+"""dispatchwatch: XLA compile / trace-cache observability.
+
+Every other lens watches the *execution* of device programs; this one
+watches their *creation*. Two surfaces, one discipline:
+
+* **compile observer** — a ``jax.monitoring`` duration-event listener
+  (``ensure_listener``) registered lazily the first time a dispatch
+  seam arms a ``compile_scope``. jax has no selective unregister, so
+  the listener stays registered for the life of the process and gates
+  internally: under ``MPIBT_TELEMETRY_OFF`` it is a flag check and
+  nothing else, and — the meshprof/memory.py cold-backend contract —
+  this module NEVER imports jax: if ``jax`` is not already in
+  ``sys.modules`` every probe is a zero-cost no-op and every snapshot
+  is ``{}``. Backend-compile events land as ``jax_compiles_total{site}``
+  + ``jax_compile_ms{site}`` in the live registry and in a bounded
+  event ring the meshwatch shard writer carries a tail of.
+* **trace-cache census** — the dispatch seams that cache jitted sweep
+  callables (``TpuBackend._searchers`` via ``select_kernel`` /
+  ``make_round_search``, ``FusedMiner._fns``, the mesh sweep) report
+  their cache size through ``note_cache`` and wrap their dispatch call
+  sites in ``compile_scope`` so every compile is attributed to the
+  seam that paid it. Both emits carry a keyword-only ``site=`` —
+  chainlint TEL007 enforces the label at every emit point, because a
+  compile without one cannot be joined to its cache (the same stance
+  as TEL005's skew-span site).
+
+The per-site invariant a healthy steady-state run keeps is
+``compiles == cache_entries``: every compile bought a cache entry that
+is reused forever after. ``recompiles()`` prices the violation
+(compiles past the cache size), the ``recompile_storm`` chainwatch
+rule watches census *growth* after warmup, and ``compile_snapshot()``
+is the carriage projection (shard ``compiles`` key, ``/healthz``
+``compiles`` key via ``meshwatch.aggregate.mesh_compiles``, incident
+bundles, the Perfetto ``xla compiles`` lane) — ``{}`` while off or
+unobserved, the skew_spans/memory/incidents carriage model.
+
+Standard library only; ``make compile-smoke`` pins the contract
+(docs/observability.md §dispatchwatch).
+"""
+from __future__ import annotations
+
+import sys
+import threading
+from collections import deque
+
+from ..telemetry.registry import telemetry_disabled
+
+#: jax.monitoring duration events worth watching, by program-creation
+#: stage. Only ``backend_compile`` counts toward the census/storm
+#: signal (an XLA executable was built); trace/lowering durations ride
+#: along as per-site stage counts.
+COMPILE_EVENTS = {
+    "/jax/core/compile/backend_compile_duration": "backend_compile",
+    "/jax/core/compile/jaxpr_trace_duration": "jaxpr_trace",
+    "/jax/core/compile/jaxpr_to_mlir_module_duration": "lowering",
+}
+
+#: Site label for compiles observed outside any ``compile_scope`` — a
+#: compile nobody attributed is itself a finding worth surfacing.
+UNSCOPED_SITE = "unscoped"
+
+#: Bounded compile-event ring (same order as the skew-span ring).
+COMPILE_RING_SIZE = 1024
+#: Newest compile events carried per shard write / Perfetto lane.
+COMPILE_TAIL_N = 64
+
+_lock = threading.Lock()
+_listening = False          # jax.monitoring listener registered (once)
+_sites: dict[str, dict] = {}
+_events: deque = deque(maxlen=COMPILE_RING_SIZE)
+_tls = threading.local()    # per-thread compile_scope site stack
+
+
+def _new_site() -> dict:
+    return {"compiles": 0, "compile_ms": 0.0, "cache_entries": 0,
+            "stages": {}}
+
+
+def current_site() -> str:
+    """The innermost live ``compile_scope`` site on this thread (the
+    listener's attribution key), ``UNSCOPED_SITE`` outside any scope."""
+    stack = getattr(_tls, "stack", None)
+    return stack[-1] if stack else UNSCOPED_SITE
+
+
+def ensure_listener() -> bool:
+    """Register the ``jax.monitoring`` duration listener, lazily and at
+    most once per process. Never the reason a process imports jax: the
+    gate is ``sys.modules`` membership (the meshprof/memory.py
+    discipline — this can run on the shard-flusher thread while the
+    main thread is mid-``import jax``, so attribute reads only, no
+    imports). False while jax is absent; callers simply retry on the
+    next emit."""
+    global _listening
+    if _listening:
+        return True
+    if telemetry_disabled():
+        return False
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return False
+    register = getattr(getattr(jax, "monitoring", None),
+                       "register_event_duration_secs_listener", None)
+    if register is None:
+        return False
+    with _lock:
+        if _listening:
+            return True
+        try:
+            register(_on_duration)
+        except Exception:
+            return False
+        _listening = True
+    return True
+
+
+def _on_duration(event: str, duration_secs: float, **kwargs) -> None:
+    """The registered listener: maps jax's compile-stage duration
+    events onto the per-site census. jax has no unregister, so the
+    kill switch is checked here, per event — the off half of the
+    overhead audit pays exactly this flag check."""
+    if telemetry_disabled():
+        return
+    stage = COMPILE_EVENTS.get(event)
+    if stage is None:
+        return
+    record_compile(site=current_site(), stage=stage,
+                   duration_s=float(duration_secs))
+
+
+def record_compile(*, site: str, stage: str = "backend_compile",
+                   duration_s: float = 0.0) -> None:
+    """One observed program-creation stage at ``site`` (keyword-only —
+    chainlint TEL007). ``backend_compile`` stages advance the census,
+    the ring, ``jax_compiles_total{site}`` and ``jax_compile_ms{site}``;
+    other stages only bump the per-site stage counts."""
+    if telemetry_disabled():
+        return
+    from ..meshprof.spans import wall_now
+
+    site = str(site)
+    ms = duration_s * 1000.0
+    with _lock:
+        st = _sites.setdefault(site, _new_site())
+        st["stages"][stage] = st["stages"].get(stage, 0) + 1
+        if stage == "backend_compile":
+            st["compiles"] += 1
+            st["compile_ms"] += ms
+            _events.append({"t": wall_now(), "site": site,
+                            "ms": round(ms, 3), "stage": stage})
+    if stage == "backend_compile":
+        from ..telemetry import counter, histogram
+
+        counter("jax_compiles_total",
+                help="XLA backend compiles observed, by dispatch seam",
+                site=site).inc()
+        histogram("jax_compile_ms",
+                  help="XLA backend compile wall time per program",
+                  site=site).observe(ms)
+
+
+class compile_scope:
+    """``with compile_scope(site="backend.tpu"): <jit call>`` — the ONE
+    compile-attribution idiom (chainlint TEL007: the ``site=`` keyword
+    is mandatory, and keyword-only here so the runtime agrees with the
+    lint). Arms the lazy listener and stamps the site every compile
+    event on this thread lands under while the scope is live. Records
+    nothing under ``MPIBT_TELEMETRY_OFF``."""
+
+    __slots__ = ("site", "_armed")
+
+    def __init__(self, *, site: str):
+        self.site = str(site)
+        self._armed = not telemetry_disabled()
+
+    def __enter__(self):
+        if not self._armed:
+            return self
+        ensure_listener()
+        stack = getattr(_tls, "stack", None)
+        if stack is None:
+            stack = _tls.stack = []
+        stack.append(self.site)
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if not self._armed:
+            return False
+        stack = getattr(_tls, "stack", None)
+        if stack:
+            stack.pop()
+        return False
+
+
+def note_cache(*, site: str, entries: int) -> None:
+    """Per-site trace-cache census emit (``site=`` keyword-only —
+    chainlint TEL007): the dispatch seams call this when their
+    compiled-fn cache changes size, so the census can price
+    ``compiles - cache_entries`` (the recompile signal) per seam.
+    Flag-check no-op under ``MPIBT_TELEMETRY_OFF``."""
+    if telemetry_disabled():
+        return
+    ensure_listener()
+    n = int(entries)
+    with _lock:
+        _sites.setdefault(str(site), _new_site())["cache_entries"] = n
+    from ..telemetry import gauge
+
+    gauge("trace_cache_entries",
+          help="cached compiled sweep callables, by dispatch seam",
+          site=site).set(n)
+
+
+def compile_census() -> dict:
+    """{site: {compiles, compile_ms, cache_entries, stages}} copies,
+    sorted by site — the recompile-storm rule's sample and the bundle
+    overlay. ``{}`` under the kill switch or when nothing was ever
+    observed (cold-backend processes stay empty-handed forever)."""
+    if telemetry_disabled():
+        return {}
+    with _lock:
+        return {site: {**st, "compile_ms": round(st["compile_ms"], 3),
+                       "stages": dict(st["stages"])}
+                for site, st in sorted(_sites.items())}
+
+
+def compile_events_tail(n: int = COMPILE_TAIL_N) -> list[dict]:
+    """Copies of the newest ``n`` compile events (the Perfetto lane's
+    slices; copies because the flusher json-serializes concurrently)."""
+    if telemetry_disabled():
+        return []
+    with _lock:
+        recs = list(_events)[-n:] if n is not None else list(_events)
+    return [dict(r) for r in recs]
+
+
+def recompiles(census: dict | None = None) -> int:
+    """Compiles the census cannot account for with a cache entry,
+    summed over sites — 0 on a healthy steady-state run (each sweep
+    callable compiled exactly once into its seam cache). Sites that
+    never reported a cache (``unscoped``) price every compile past the
+    first as a recompile."""
+    if census is None:
+        census = compile_census()
+    total = 0
+    for st in census.values():
+        have = int(st.get("cache_entries", 0)) or 1
+        total += max(0, int(st.get("compiles", 0)) - have)
+    return total
+
+
+def compile_snapshot() -> dict:
+    """The carriage projection (shard ``compiles`` key, ``/healthz``
+    via ``mesh_compiles``, incident bundles): per-site census + the
+    newest compile events. ``{}`` while disarmed/off/unobserved — the
+    skew_spans/memory/incidents carriage model, so a cold-backend rank
+    costs its shard nothing."""
+    if telemetry_disabled():
+        return {}
+    sites = compile_census()
+    events = compile_events_tail()
+    if not sites and not events:
+        return {}
+    return {"sites": sites, "events": events}
+
+
+def clear_compiles() -> None:
+    """Reset the census and the event ring (test / smoke-leg isolation;
+    the listener registration — a process-lifetime fact — stays)."""
+    with _lock:
+        _sites.clear()
+        _events.clear()

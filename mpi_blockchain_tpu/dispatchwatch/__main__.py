@@ -1,0 +1,205 @@
+"""CLI: python -m mpi_blockchain_tpu.dispatchwatch {census,smoke}
+
+``census`` prints this process's compile census + the measured-cost
+cross-check as JSON (a debugging convenience — a fresh CLI process has
+an empty census until ``--probe`` compiles the probe sweep).
+
+``smoke`` is the ``make compile-smoke`` gate (docs/observability.md
+§dispatchwatch):
+
+1. a fixed-seed instrumented cpu-world mine through the DEVICE backend
+   (sequential leg, then the async pipelined leg) must compile each
+   sweep callable exactly once — per-site ``compiles == cache_entries``
+   and zero recompiles after warmup, judged through the perfwatch
+   detector's ``compile_cache`` absolute bound (<= 0);
+2. chainwatch rides both legs armed: the clean mine must fire zero
+   ``recompile_storm`` incidents (the false-positive contract);
+3. both legs must mine byte-identical chains (instrumentation is an
+   observer, never a participant);
+4. the HLO measured-cost cross-check must report a positive
+   flops-per-nonce next to the committed OPBUDGET census and their
+   ratio (the acceptance row ``perfwatch compiles`` serves users).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+#: The fixed smoke config: difficulty low enough that every block's
+#: deterministic winner sits within a few 2^12 rounds (the while_loop
+#: sweeps them inside ONE dispatch), batch small enough that the cpu
+#: XLA compile is ~a second. Winner nonces are a pure function of
+#: (prefix, difficulty), so the census cannot drift per machine.
+SMOKE_DIFFICULTY = 12
+SMOKE_BLOCKS = 4
+SMOKE_BATCH_POW2 = 12
+SMOKE_PREFIX = "dispatch"
+
+
+def _mine_leg(pipeline: bool) -> dict:
+    """One fixed-seed device-backend mine with a fresh census and an
+    armed chainwatch; returns the leg's census + incident verdicts."""
+    from .. import chainwatch
+    from ..config import MinerConfig
+    from ..models.miner import Miner
+    from . import clear_compiles, compile_census, recompiles
+
+    clear_compiles()
+    chainwatch.install()
+    try:
+        cfg = MinerConfig(difficulty_bits=SMOKE_DIFFICULTY,
+                          n_blocks=SMOKE_BLOCKS, backend="tpu",
+                          batch_pow2=SMOKE_BATCH_POW2,
+                          data_prefix=SMOKE_PREFIX)
+        miner = Miner(cfg, pipeline=pipeline, log_fn=lambda rec: None)
+        miner.mine_chain()
+        chainwatch.evaluate(source="compile-smoke", force=True)
+        storms = [i for i in chainwatch.open_incidents()
+                  if i.get("rule") == "recompile_storm"]
+        census = compile_census()
+        return {
+            "census": census,
+            "recompiles": recompiles(census),
+            "storm_incidents": len(storms),
+            "chain": miner.chain_hashes(),
+        }
+    finally:
+        chainwatch.uninstall()
+
+
+def measure_compile_census() -> dict:
+    """The ``compile_cache`` bench payload: both legs' censuses, the
+    section headline ``recompiles_after_warmup`` (pipelined leg,
+    bounded at 0 by detector.SECTION_BOUNDS), the determinism contract
+    and the measured-cost cross-check."""
+    from .cost import cost_cross_check
+
+    seq = _mine_leg(False)
+    pip = _mine_leg(True)
+    try:
+        cost = cost_cross_check(batch_pow2=SMOKE_BATCH_POW2,
+                                difficulty_bits=SMOKE_DIFFICULTY)
+    except RuntimeError as e:
+        cost = {"error": str(e)}
+    return {
+        "backend": "tpu",
+        "difficulty_bits": SMOKE_DIFFICULTY,
+        "n_blocks": SMOKE_BLOCKS,
+        "batch_pow2": SMOKE_BATCH_POW2,
+        # The section headline, bounded by SECTION_BOUNDS (<= 0).
+        "recompiles_after_warmup": pip["recompiles"],
+        "recompiles_sequential": seq["recompiles"],
+        "sites": pip["census"],
+        "sites_sequential": seq["census"],
+        "storm_incidents": seq["storm_incidents"] + pip["storm_incidents"],
+        "chain_identical": seq["chain"] == pip["chain"],
+        "cost": cost,
+    }
+
+
+def _census_clean(census: dict) -> bool:
+    """Exactly-once contract for one leg: the device seam compiled, and
+    every site that reported a cache holds compiles == cache_entries."""
+    if "backend.tpu" not in census:
+        return False
+    return all(st["compiles"] == st["cache_entries"]
+               for st in census.values() if st.get("cache_entries"))
+
+
+def cmd_smoke(args) -> int:
+    """See module docstring — the make compile-smoke gate."""
+    import logging
+
+    from ..perfwatch.detector import check_candidate
+    from ..perfwatch.history import DEFAULT_HISTORY_NAME, HistoryStore
+
+    logging.getLogger("mpi_blockchain_tpu").setLevel(logging.WARNING)
+    repo_root = pathlib.Path(__file__).resolve().parent.parent.parent
+    store = HistoryStore(repo_root / DEFAULT_HISTORY_NAME)
+    payload = measure_compile_census()
+    finding = check_candidate(store, "compile_cache", payload)
+    # None of this is weather: a recompile, a storm incident, a chain
+    # divergence or a missing cross-check is a real defect — one dirty
+    # read fails the gate outright, no best-of-N.
+    if finding.verdict == "regression":
+        print(f"compile-smoke: recompiles over budget: "
+              f"{finding.render()}", file=sys.stderr)
+        return 1
+    for leg, census in (("sequential", payload["sites_sequential"]),
+                        ("pipelined", payload["sites"])):
+        if not _census_clean(census):
+            print(f"compile-smoke: {leg} census not exactly-once: "
+                  f"{json.dumps(census, sort_keys=True)}",
+                  file=sys.stderr)
+            return 1
+    if payload["storm_incidents"]:
+        print(f"compile-smoke: clean mine fired "
+              f"{payload['storm_incidents']} recompile_storm "
+              f"incident(s)", file=sys.stderr)
+        return 1
+    if not payload["chain_identical"]:
+        print("compile-smoke: pipelined chain diverged from the "
+              "sequential leg", file=sys.stderr)
+        return 1
+    cost = payload["cost"]
+    if cost.get("flops_per_nonce", 0) <= 0 or \
+            "measured_over_committed" not in cost:
+        print(f"compile-smoke: measured-cost cross-check incomplete: "
+              f"{json.dumps(cost, sort_keys=True)}", file=sys.stderr)
+        return 1
+    print(json.dumps({
+        "event": "compile_smoke", "ok": True,
+        "recompiles_after_warmup": payload["recompiles_after_warmup"],
+        "compiles": {site: st["compiles"]
+                     for site, st in payload["sites"].items()},
+        "storm_incidents": payload["storm_incidents"],
+        "chain_identical": payload["chain_identical"],
+        "flops_per_nonce": cost["flops_per_nonce"],
+        "alu_ops_per_nonce": cost.get("alu_ops_per_nonce"),
+        "measured_over_committed": cost.get("measured_over_committed"),
+        "verdict": finding.verdict,
+    }, sort_keys=True))
+    return 0
+
+
+def cmd_census(args) -> int:
+    from . import compile_snapshot
+
+    out = {"event": "dispatchwatch_census",
+           "compiles": compile_snapshot()}
+    if args.probe:
+        from .cost import cost_cross_check
+        try:
+            out["cost"] = cost_cross_check()
+        except RuntimeError as e:
+            out["cost"] = {"error": str(e)}
+    print(json.dumps(out, sort_keys=True))
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m mpi_blockchain_tpu.dispatchwatch",
+        description="XLA compile/trace-cache observability")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p_cen = sub.add_parser("census", help="this process's compile "
+                                          "census as JSON")
+    p_cen.add_argument("--probe", action="store_true",
+                       help="also AOT-compile the probe sweep and "
+                            "report the measured-cost cross-check")
+    p_cen.set_defaults(fn=cmd_census)
+
+    p_smk = sub.add_parser("smoke", help="the make compile-smoke gate: "
+                                         "fixed-seed mine -> "
+                                         "deterministic compile census")
+    p_smk.set_defaults(fn=cmd_smoke)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,100 @@
+"""Measured-cost roofline cross-check: HLO cost analysis vs OPBUDGET.
+
+The PR 14 ALU-floor proof is a *closed-form static census*
+(``perfwatch.attribution.kernel_op_model`` — committed as
+``alu_ops_per_nonce`` in OPBUDGET.json and ratcheted by chainlint).
+This module is its independent, *measured* verification: AOT-compile
+the actual multi-round sweep executable, ask XLA's own HLO cost
+analysis what it costs (flops / bytes accessed), and report
+flops-per-nonce next to the committed census with their ratio.
+
+The two numbers answer different questions and are NOT expected to be
+equal: the census counts u32 ALU ops the kernel *algorithm* demands
+per nonce; XLA's flop count is what the *compiled program* executes
+per element after CSE/fusion/strength reduction on the target backend
+(rotations folded, the multi-round loop body counted once). The ratio
+is the point — a kernel change that moves it sharply moved real work,
+whichever ledger it hid from. ``perfwatch compiles`` surfaces it;
+``make compile-smoke`` pins that the measurement stays available.
+
+Unlike the observer (``dispatchwatch.__init__`` — cold-backend, never
+imports jax), this is a CLI/bench seam in the ``experiments/roofline``
+tradition: calling it imports jax deliberately, so only CLIs and
+smokes call it, never the telemetry path.
+"""
+from __future__ import annotations
+
+#: Default probe shape: one Pallas-tile-sized batch (2^13 nonces) at a
+#: mid difficulty — big enough that per-element work dominates the
+#: program, small enough to compile in ~a second on a cpu world.
+PROBE_BATCH_POW2 = 13
+PROBE_DIFFICULTY = 16
+
+
+def measured_cost(batch_pow2: int = PROBE_BATCH_POW2,
+                  difficulty_bits: int = PROBE_DIFFICULTY,
+                  kernel: str = "auto") -> dict:
+    """AOT-compiles the multi-round sweep (the same builder the tpu
+    backend caches — ``make_multiround_search_fn``) and returns XLA's
+    HLO cost analysis of the executable, normalized per nonce.
+
+    Raises RuntimeError when jax or the cost analysis is unavailable
+    (callers decide whether that fails a gate or degrades a report).
+    """
+    try:
+        import numpy as np
+
+        from .. import core
+        from ..backend.tpu import make_multiround_search_fn
+        from ..ops.sha256_sched import extend_midstate
+    except ImportError as e:                        # pragma: no cover
+        raise RuntimeError(f"measured cost needs jax: {e}") from e
+
+    from . import compile_scope
+
+    batch = 1 << batch_pow2
+    fn, effective = make_multiround_search_fn(batch, difficulty_bits,
+                                              kernel=kernel)
+    midstate, tail = core.header_midstate(b"\x00" * 80)
+    ext = extend_midstate(midstate, tail)
+    with compile_scope(site="cost-probe"):
+        compiled = fn.lower(ext, np.uint32(0), np.uint32(1)).compile()
+    try:
+        analysis = compiled.cost_analysis()
+    except (AttributeError, NotImplementedError, RuntimeError) as e:
+        raise RuntimeError(f"cost_analysis unavailable: {e}") from e
+    if isinstance(analysis, (list, tuple)):
+        analysis = analysis[0] if analysis else {}
+    flops = float(analysis.get("flops", 0.0) or 0.0)
+    bytes_accessed = float(analysis.get("bytes accessed", 0.0) or 0.0)
+    return {
+        "kernel": effective,
+        "batch_pow2": batch_pow2,
+        "difficulty_bits": difficulty_bits,
+        "hlo_flops": flops,
+        "hlo_bytes_accessed": bytes_accessed,
+        "flops_per_nonce": round(flops / batch, 3),
+        "bytes_per_nonce": round(bytes_accessed / batch, 3),
+    }
+
+
+def cost_cross_check(batch_pow2: int = PROBE_BATCH_POW2,
+                     difficulty_bits: int = PROBE_DIFFICULTY,
+                     kernel: str = "auto", root=None) -> dict:
+    """``measured_cost`` joined with the committed OPBUDGET census:
+    adds ``alu_ops_per_nonce`` (the PR 14 closed form, 5996 at the
+    committed cut) and ``measured_over_committed`` (the ratio the
+    smoke pins as present and positive). The census keys are simply
+    absent when OPBUDGET.json is unreadable — measurement beats
+    emptiness, the report never lies about what it compared."""
+    from ..perfwatch.attribution import committed_census
+
+    out = measured_cost(batch_pow2=batch_pow2,
+                        difficulty_bits=difficulty_bits, kernel=kernel)
+    budget = committed_census(root) or committed_census()
+    ops = (budget or {}).get("alu_ops_per_nonce")
+    if isinstance(ops, int) and ops > 0:
+        out["alu_ops_per_nonce"] = ops
+        out["measured_over_committed"] = round(
+            out["flops_per_nonce"] / ops, 4)
+    return out

@@ -267,7 +267,8 @@ def mesh_health(directory, stall_s: float | None = None,
                      "stale_ranks": [], "failed_ranks": [],
                      "missing_ranks": [],
                      "live_ranks": 0, "world_size": 0,
-                     "skew": {}, "memory": {}, "incidents": []}
+                     "skew": {}, "memory": {}, "incidents": [],
+                     "compiles": {}}
     status = rank_status(shards, stall_s=stall_s, now=now,
                          heartbeat_stall_s=heartbeat_stall_s)
     ranks = status["ranks"]
@@ -327,6 +328,10 @@ def mesh_health(directory, stall_s: float | None = None,
         # every pre-existing key keeps its shape (the schema pin in
         # tests/test_meshwatch.py).
         "incidents": mesh_incidents(shards),
+        # Per-rank compile census (dispatchwatch carriage): divergent
+        # compile counts across ranks are the desync smell single-chip
+        # CI can't reproduce — flagged here before the hang.
+        "compiles": mesh_compiles(shards),
     }
     return (200 if healthy else 503), payload
 
@@ -342,6 +347,34 @@ def mesh_incidents(shards: list[dict]) -> list[dict]:
                 out.append({**inc, "rank": int(shard["rank"])})
     out.sort(key=lambda i: (i["rank"], i.get("incident_seq", 0)))
     return out
+
+
+def mesh_compiles(shards: list[dict]) -> dict:
+    """Mesh-wide compile-census view off the shard ``compiles``
+    carriage: per-rank backend-compile totals (with the per-site
+    breakdown), the min/max across reporting ranks and a ``divergent``
+    flag when they disagree — the every-rank-must-compile-the-same-
+    programs invariant a multi-chip bring-up is accepted against.
+    ``{}`` when no rank carries a census (cold-backend mesh). Pure
+    function — ``/healthz`` and ``perfwatch compiles`` share it."""
+    by_rank: dict[str, dict] = {}
+    for shard in shards:
+        sites = (shard.get("compiles") or {}).get("sites") or {}
+        if not sites:
+            continue
+        by_rank[str(int(shard["rank"]))] = {
+            "total": sum(int(st.get("compiles", 0))
+                         for st in sites.values()),
+            "sites": {site: int(st.get("compiles", 0))
+                      for site, st in sorted(sites.items())},
+        }
+    if not by_rank:
+        return {}
+    totals = [v["total"] for v in by_rank.values()]
+    return {"by_rank": dict(sorted(by_rank.items(),
+                                   key=lambda kv: int(kv[0]))),
+            "max": max(totals), "min": min(totals),
+            "divergent": max(totals) != min(totals)}
 
 
 # ---- Prometheus rendering -------------------------------------------------

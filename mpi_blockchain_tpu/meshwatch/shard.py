@@ -31,7 +31,11 @@ seconds (default 1.0) and once more at close. The shard carries:
   imported jax);
 * ``incidents`` — the rank's open chainwatch incidents (empty while
   the watchdog is disarmed); the flush tick is also one of chainwatch's
-  two rule-evaluation cadences.
+  two rule-evaluation cadences;
+* ``compiles`` — the rank's dispatchwatch compile snapshot (per-site
+  census + event tail; ``{}`` on ranks that never observed a compile),
+  so divergent per-rank compile counts surface in ``mesh_health``
+  before the desync hang they precede.
 
 Wall-clock timestamps are deliberate here (unlike the causal logs):
 staleness is a wall-clock question, and shards never participate in the
@@ -120,6 +124,7 @@ class ShardWriter:
             seq = self._seq
         from ..chainwatch import evaluate as chainwatch_evaluate
         from ..chainwatch import open_incidents
+        from ..dispatchwatch import compile_snapshot
         from ..meshprof.memory import memory_snapshot
         from ..meshprof.spans import SKEW_TAIL_N, spans_tail
         from .pipeline import profiler
@@ -162,6 +167,10 @@ class ShardWriter:
             # model as skew_spans/memory: [] while disarmed) so the
             # aggregator's /healthz and /incidents views see them.
             "incidents": open_incidents(),
+            # Dispatchwatch compile census rides the same carriage ({}
+            # on cold-backend ranks) so mesh_health can flag divergent
+            # per-rank compile counts before the desync hang.
+            "compiles": compile_snapshot(),
         }
 
     # ---- writing ---------------------------------------------------------

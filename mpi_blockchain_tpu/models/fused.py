@@ -33,6 +33,7 @@ from .. import core
 from ..blocktrace import trace_block
 from ..blocktrace.critical_path import observe_batch_metrics
 from ..config import MAX_EXTRA_NONCE, ConfigError, extend_payload
+from ..dispatchwatch import compile_scope, note_cache
 from ..meshwatch.pipeline import profiler, strip_block_identity
 from ..telemetry import counter, heartbeat, histogram
 from ..telemetry.spans import span
@@ -179,6 +180,7 @@ class FusedMiner:
                 n_miners=self.config.n_miners, mesh=self._mesh,
                 kernel=self.config.kernel, donate=donate)
             self._fns[key] = fn
+            note_cache(site="fused", entries=len(self._fns))
         return fn
 
     def warmup(self, k: int | None = None) -> None:
@@ -196,10 +198,12 @@ class FusedMiner:
         if not hasattr(fn, "lower"):    # already an AOT executable
             return
         u32 = np.uint32
-        self._fns[(k, True)] = fn.lower(
-            jax.ShapeDtypeStruct((8,), u32),
-            jax.ShapeDtypeStruct((k, 8), u32),
-            jax.ShapeDtypeStruct((), u32)).compile()
+        with compile_scope(site="fused"):
+            self._fns[(k, True)] = fn.lower(
+                jax.ShapeDtypeStruct((8,), u32),
+                jax.ShapeDtypeStruct((k, 8), u32),
+                jax.ShapeDtypeStruct((), u32)).compile()
+        note_cache(site="fused", entries=len(self._fns))
 
     def mine_chain(self, n_blocks: int | None = None,
                    on_progress=None) -> None:
@@ -278,7 +282,8 @@ class FusedMiner:
                             for j in range(k)]
                 data_words = np.stack([_words_be(core.sha256d(p))
                                        for p in payloads])
-                with span("fused.dispatch", k=k, height=height):
+                with span("fused.dispatch", k=k, height=height), \
+                        compile_scope(site="fused"):
                     # prev_words is DONATED (declared on the jit via
                     # make_fused_miner donate=True): the tip-words
                     # buffer is handed output -> input across pipelined

@@ -271,6 +271,7 @@ def make_mesh_sweep_fn(mesh: Mesh, batch_size: int, difficulty_bits: int,
     extended-midstate precompute (``ops.sha256_sched.extend_midstate``)
     runs once per call on replicated scalars, outside the shard_map.
     """
+    from ..dispatchwatch import note_cache
     from ..ops import extend_midstate, select_kernel
 
     sweep, _ = select_kernel(kernel, batch_size, difficulty_bits, shard=True)
@@ -287,6 +288,7 @@ def make_mesh_sweep_fn(mesh: Mesh, batch_size: int, difficulty_bits: int,
                                        jnp.asarray(tail_w, _U32)), base)
 
     jfn = jax.jit(fn)
+    note_cache(site="mesh.sweep", entries=1)
 
     def instrumented(midstate, tail_w, base):
         # Host-side skew span around the sharded dispatch (the call,
@@ -294,9 +296,11 @@ def make_mesh_sweep_fn(mesh: Mesh, batch_size: int, difficulty_bits: int,
         # this process's arrival at the round whose epilogue is the
         # winner-select rendezvous, joinable across hosts on a
         # multi-process mesh.
+        from ..dispatchwatch import compile_scope
         from ..meshprof.spans import skew_span
 
-        with skew_span(site="mesh.sweep"):
+        with skew_span(site="mesh.sweep"), \
+                compile_scope(site="mesh.sweep"):
             return jfn(midstate, tail_w, base)
 
     return instrumented

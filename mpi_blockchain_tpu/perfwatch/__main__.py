@@ -1,5 +1,5 @@
 """CLI: python -m mpi_blockchain_tpu.perfwatch
-{record,check,report,critical-path,mesh-skew,incidents,serve}
+{record,check,report,critical-path,mesh-skew,incidents,compiles,serve}
 
 The perf-regression sentinel as a merge gate:
 
@@ -30,6 +30,12 @@ The perf-regression sentinel as a merge gate:
     # open chainwatch incidents of a mesh (+ evidence bundles)
     python -m mpi_blockchain_tpu.perfwatch incidents \\
         --mesh-dir /tmp/mesh --bundle-dir /tmp/incidents --json
+
+    # XLA compile census (dispatchwatch): measured HLO flops-per-nonce
+    # vs the committed OPBUDGET census, + per-rank compile counts from
+    # a --mesh-obs shard dir
+    python -m mpi_blockchain_tpu.perfwatch compiles \\
+        --mesh-dir /tmp/mesh --json
 
     # standalone endpoint (mine/sim/bench embed the same server via
     # --serve-metrics PORT); serves until interrupted
@@ -334,6 +340,72 @@ def cmd_incidents(args) -> int:
     return 0
 
 
+def cmd_compiles(args) -> int:
+    """XLA compile / trace-cache census (dispatchwatch). Three views,
+    composable: this process's census, the mesh view off a --mesh-obs
+    shard directory's ``compiles`` carriage (per-rank compile totals +
+    the divergence flag), and — unless --no-probe — the measured-cost
+    cross-check: HLO cost-analysis flops-per-nonce of the AOT-compiled
+    sweep next to the committed OPBUDGET ``alu_ops_per_nonce`` with
+    their ratio. Exit 0 always — reporting, not gating (``make
+    compile-smoke`` is the gate)."""
+    from ..dispatchwatch import compile_census, recompiles
+
+    census = compile_census()
+    out: dict = {"event": "perfwatch_compiles",
+                 "local": {"sites": census,
+                           "recompiles": recompiles(census)}}
+    if args.mesh_dir:
+        from ..meshwatch.aggregate import mesh_compiles, read_shards
+        out["mesh"] = mesh_compiles(read_shards(args.mesh_dir))
+        out["source"] = str(args.mesh_dir)
+    if not args.no_probe:
+        from ..dispatchwatch.cost import cost_cross_check
+        try:
+            out["cost"] = cost_cross_check()
+        except RuntimeError as e:
+            out["cost"] = {"error": str(e)}
+    if args.as_json:
+        print(json.dumps(out, sort_keys=True))
+        return 0
+    if census:
+        print("local compile census:")
+        for site, st in census.items():
+            print(f"  {site:>14}: {st['compiles']} compile(s), "
+                  f"{st['compile_ms']:.1f} ms, cache "
+                  f"{st['cache_entries']}")
+        print(f"  recompiles past cache: {out['local']['recompiles']}")
+    else:
+        print("local compile census: empty (nothing observed "
+              "in this process)")
+    mesh = out.get("mesh")
+    if mesh:
+        flag = " DIVERGENT" if mesh.get("divergent") else ""
+        print(f"mesh compiles (min {mesh['min']}, max {mesh['max']})"
+              f"{flag}:")
+        for rank, v in mesh["by_rank"].items():
+            sites = ", ".join(f"{s}={n}" for s, n in v["sites"].items())
+            print(f"  rank {rank}: {v['total']} ({sites})")
+    elif args.mesh_dir:
+        print(f"mesh compiles: no census in shards under "
+              f"{args.mesh_dir}")
+    cost = out.get("cost")
+    if cost is not None:
+        if "error" in cost:
+            print(f"measured cost: unavailable ({cost['error']})")
+        else:
+            line = (f"measured cost ({cost['kernel']}, batch "
+                    f"2^{cost['batch_pow2']}): "
+                    f"{cost['flops_per_nonce']} HLO flops/nonce, "
+                    f"{cost['bytes_per_nonce']} bytes/nonce")
+            if "alu_ops_per_nonce" in cost:
+                line += (f" | committed census "
+                         f"{cost['alu_ops_per_nonce']} ALU ops/nonce "
+                         f"(ratio {cost['measured_over_committed']})")
+            print(line)
+    return 0
+
+
 def cmd_critical_path(args) -> int:
     """Per-block critical-path attribution (blocktrace): joins pipeline
     records mesh-wide (from --mesh-dir shards, or the in-process
@@ -342,6 +414,7 @@ def cmd_critical_path(args) -> int:
 
     skew_spans: dict = {}
     incidents: list = []
+    compiles: dict = {}
     if args.mesh_dir:
         from ..meshwatch.aggregate import mesh_incidents, read_shards
         shards = read_shards(args.mesh_dir)
@@ -349,17 +422,26 @@ def cmd_critical_path(args) -> int:
         skew_spans = {str(s["rank"]): s["skew_spans"] for s in shards
                       if s.get("skew_spans") and s.get("rank") is not None}
         incidents = mesh_incidents(shards)
+        compiles = {str(s["rank"]): (s.get("compiles") or {}).get("events")
+                    for s in shards
+                    if (s.get("compiles") or {}).get("events")
+                    and s.get("rank") is not None}
     else:
         from ..chainwatch import open_incidents
+        from ..dispatchwatch import compile_events_tail
         from ..meshwatch.pipeline import profiler
         records = profiler().records()
         incidents = open_incidents()
+        events = compile_events_tail()
+        if events:
+            compiles = {"0": events}
     report = critical_path_report(records, height=args.height)
     if args.trace:
         from ..blocktrace.export import to_critical_path_trace
         trace = to_critical_path_trace(report, records,
                                        skew_spans=skew_spans,
-                                       incidents=incidents)
+                                       incidents=incidents,
+                                       compiles=compiles)
         pathlib.Path(args.trace).write_text(
             json.dumps(trace, sort_keys=True))
     if args.as_json:
@@ -576,6 +658,21 @@ def main(argv: list[str] | None = None) -> int:
                             "(mine --incident-dir)")
     p_inc.add_argument("--json", action="store_true", dest="as_json")
     p_inc.set_defaults(fn=cmd_incidents)
+
+    p_cmp = sub.add_parser(
+        "compiles",
+        help="XLA compile/trace-cache census (dispatchwatch): local + "
+             "per-rank mesh counts, measured HLO flops-per-nonce vs "
+             "the committed OPBUDGET census with their ratio")
+    p_cmp.add_argument("--mesh-dir", metavar="DIR", default=None,
+                       help="also merge the compiles carriage of this "
+                            "--mesh-obs shard directory (per-rank "
+                            "totals + divergence flag)")
+    p_cmp.add_argument("--no-probe", action="store_true",
+                       help="skip the AOT measured-cost probe (the "
+                            "probe imports jax and compiles the sweep)")
+    p_cmp.add_argument("--json", action="store_true", dest="as_json")
+    p_cmp.set_defaults(fn=cmd_compiles)
 
     p_srv = sub.add_parser("serve", help="standalone metrics endpoint "
                                          "(until interrupted)")

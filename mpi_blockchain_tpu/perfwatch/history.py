@@ -38,6 +38,13 @@ wall-clock, lower is better):
                     absolute SECTION_BOUNDS cap; clock offsets are
                     normalized out so the number is scheduler jitter,
                     not process-startup stagger
+    compile_cache   recompiles_after_warmup of the fixed-seed
+                    instrumented device-backend mine (`make
+                    compile-smoke`, dispatchwatch) — SECTION_BOUNDS
+                    caps it at 0: every sweep callable compiles exactly
+                    once into its seam cache; the payload also carries
+                    the per-site census and the HLO measured-cost
+                    cross-check vs the committed OPBUDGET census
 
 Seeding: ``seed_from_bench_rounds`` imports the repo's existing
 ``BENCH_r0*.json`` round records (fresh measurements only — ``cached``
@@ -70,6 +77,7 @@ SECTION_METRICS: dict[str, tuple[str, str | None]] = {
     "trace_block_observe": ("block_observe_us", None),
     "pipeline_bubble": ("bubble_fraction", None),
     "collective_skew": ("max_skew_ms", None),
+    "compile_cache": ("recompiles_after_warmup", None),
 }
 
 _KEY_FIELDS = ("preset", "kernel", "mesh", "backend")

@@ -680,6 +680,109 @@ def test_tel006_cli_pass_family(tmp_path):
     assert "TEL006" in proc.stdout
 
 
+# ---- TEL007: site keyword at dispatchwatch compile emit points ---------
+
+
+COMPILE_EMITS = textwrap.dedent("""\
+    from mpi_blockchain_tpu.dispatchwatch import compile_scope, note_cache
+    from mpi_blockchain_tpu.dispatchwatch import (
+        compile_scope as _compile_scope)
+
+
+    def dispatch(fn, cache, kw):
+        with compile_scope():                          # no site
+            fn()
+        with _compile_scope():                         # aliased, no site
+            fn()
+        note_cache(entries=len(cache))                 # no site
+        with compile_scope(site="backend.tpu"):        # attributed
+            fn()
+        note_cache(site="fused", entries=len(cache))   # attributed
+        note_cache(**kw)                               # opaque spread
+    """)
+
+COMPILE_CLEAN = textwrap.dedent("""\
+    from mpi_blockchain_tpu.dispatchwatch import compile_scope, note_cache
+
+
+    def dispatch(fn, cache):
+        with compile_scope(site="mesh.sweep"):
+            fn()
+        note_cache(site="mesh.sweep", entries=len(cache))
+    """)
+
+
+def test_tel007_unattributed_compile_emit_fires(tmp_path):
+    from mpi_blockchain_tpu.analysis.telemetry_lint import run_telemetry_lint
+
+    bad = tmp_path / "compile_emits.py"
+    bad.write_text(COMPILE_EMITS)
+    findings = run_telemetry_lint(
+        ROOT, overrides={"compile_scope_files": [bad],
+                         "telemetry_files": []})
+    assert rule_set(findings) == {"TEL007"}
+    # siteless scope + aliased siteless scope + siteless note = 3;
+    # attributed emits and the ** spread pass.
+    assert len(findings) == 3
+    assert all("site" in f.message for f in findings)
+
+
+def test_tel007_clean_fixture_passes(tmp_path):
+    from mpi_blockchain_tpu.analysis.telemetry_lint import run_telemetry_lint
+
+    good = tmp_path / "compile_clean.py"
+    good.write_text(COMPILE_CLEAN)
+    findings = run_telemetry_lint(
+        ROOT, overrides={"compile_scope_files": [good],
+                         "telemetry_files": []})
+    assert "TEL007" not in rule_set(findings)
+
+
+def test_tel007_out_of_scope_file_not_checked(tmp_path):
+    from mpi_blockchain_tpu.analysis.telemetry_lint import run_telemetry_lint
+
+    bad = tmp_path / "compile_emits.py"
+    bad.write_text(COMPILE_EMITS)
+    findings = run_telemetry_lint(
+        ROOT, overrides={"compile_scope_files": [],
+                         "telemetry_files": [bad]})
+    assert "TEL007" not in rule_set(findings)
+
+
+def test_tel007_live_tree_clean():
+    """Every live compile emit point is attributed, and the live scope
+    actually covers the subsystem plus the wired dispatch seams."""
+    from mpi_blockchain_tpu.analysis.telemetry_lint import (
+        _compile_scope_files, run_telemetry_lint)
+
+    rels = {str(p.relative_to(ROOT)) for p in _compile_scope_files(ROOT)}
+    for expected in ("mpi_blockchain_tpu/dispatchwatch/__init__.py",
+                     "mpi_blockchain_tpu/dispatchwatch/cost.py",
+                     "mpi_blockchain_tpu/backend/tpu.py",
+                     "mpi_blockchain_tpu/models/fused.py",
+                     "mpi_blockchain_tpu/parallel/mesh.py",
+                     "mpi_blockchain_tpu/blocktrace/overhead.py"):
+        assert expected in rels, expected
+    findings = [f for f in run_telemetry_lint(ROOT)
+                if f.rule == "TEL007"]
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_tel007_cli_pass_family(tmp_path):
+    from mpi_blockchain_tpu.analysis.__main__ import OVERRIDE_KEYS
+
+    assert "compile_scope_files" in OVERRIDE_KEYS
+    bad = tmp_path / "compile_emits.py"
+    bad.write_text(COMPILE_EMITS)
+    proc = subprocess.run(
+        [sys.executable, "-m", "mpi_blockchain_tpu.analysis",
+         "--passes", "telemetry", "--override",
+         f"compile_scope_files={bad}"],
+        cwd=ROOT, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "TEL007" in proc.stdout
+
+
 def test_tel002_cli_pass_family(tmp_path):
     bad = tmp_path / "bad_metrics.py"
     bad.write_text(BAD_METRICS)

@@ -342,8 +342,8 @@ def test_shard_payload_carries_skew_spans_and_memory(tmp_path):
 
 def test_mesh_health_payload_schema_pin():
     """The /healthz schema: every pre-existing key unchanged, plus the
-    additive meshprof `skew`/`memory` and chainwatch `incidents`
-    fields."""
+    additive meshprof `skew`/`memory`, chainwatch `incidents` and
+    dispatchwatch `compiles` fields."""
     spans0 = [span("block.step", i, 1000.0 + i) for i in range(3)]
     spans1 = [span("block.step", i, 1000.0 + i + 0.002 * (i % 2))
               for i in range(3)]
@@ -359,8 +359,10 @@ def test_mesh_health_payload_schema_pin():
     assert set(health) == {"status", "healthy", "world_size", "stall_s",
                            "heartbeat_stall_s", "live_ranks",
                            "stale_ranks", "failed_ranks", "missing_ranks",
-                           "ranks", "skew", "memory", "incidents"}
+                           "ranks", "skew", "memory", "incidents",
+                           "compiles"}
     assert health["incidents"] == []
+    assert health["compiles"] == {}     # no shard carried a census
     assert health["skew"]["sites"]["block.step"]["straggler_rank"] == 1
     assert health["memory"] == {"0": {"dev0": {"bytes_in_use": 7}}}
 
