@@ -8,13 +8,13 @@ SHELL := /bin/bash   # tier1 uses pipefail/PIPESTATUS
         metrics-smoke forensics-smoke \
         perf-smoke chaos-smoke adversary-smoke meshwatch-smoke \
         elastic-smoke trace-smoke pipeline-smoke skew-smoke \
-        incident-smoke compile-smoke tier1 core clean
+        incident-smoke compile-smoke serve-smoke tier1 core clean
 
 check: lint opbudget-check shardbudget-check metrics-smoke \
         forensics-smoke perf-smoke \
         chaos-smoke adversary-smoke meshwatch-smoke elastic-smoke \
         trace-smoke pipeline-smoke skew-smoke incident-smoke \
-        compile-smoke tier1
+        compile-smoke serve-smoke tier1
 
 # chainlint: binding contract, header layout, JAX purity, sanitizer
 # matrix, thread races (CONC), SPMD collectives, hot-path blocking,
@@ -223,6 +223,17 @@ compile-smoke:
 	env JAX_PLATFORMS=cpu $(PY) -m mpi_blockchain_tpu.dispatchwatch \
 	    smoke 2>/dev/null || { echo "compile-smoke: failed"; exit 1; }; \
 	echo "compile-smoke: ok"
+
+# Blockserve smoke: seeded loadgen against a live served mine under a
+# strict fault plan (service.submit hang + service.rebuild raise) and
+# a forced mid-run backend step-down — every request answers typed
+# within its deadline, zero accepted-then-lost transactions, the chain
+# is byte-identical to the no-service oracle, and the measured p99
+# holds the `serve` SECTION_BOUNDS budget (docs/serving.md).
+serve-smoke:
+	env JAX_PLATFORMS=cpu $(PY) -m mpi_blockchain_tpu.service \
+	    smoke 2>/dev/null || { echo "serve-smoke: failed"; exit 1; }; \
+	echo "serve-smoke: ok"
 
 # Tier-1 verify, verbatim from ROADMAP.md.
 tier1:

@@ -79,6 +79,10 @@ SANCTIONED_SEAMS = (
     "mpi_blockchain_tpu/resilience/policy.py",
     "mpi_blockchain_tpu/resilience/injection.py",
     "mpi_blockchain_tpu/utils/logging.py",
+    # blockserve: the miner only ever touches the service through
+    # TemplateFeed.payload_for (lock-guarded in-memory template read;
+    # rebuilds happen on handler threads) — sanctioned like telemetry.
+    "mpi_blockchain_tpu/service",
 )
 
 #: Dotted (module, func) pairs that block the calling thread.

@@ -54,6 +54,10 @@ DISPATCH_IO_PATHS = (
     "mpi_blockchain_tpu/simulation.py",
     "mpi_blockchain_tpu/models",
     "mpi_blockchain_tpu/parallel/distributed.py",
+    # blockserve: the front door's admission/rebuild paths are dispatch
+    # IO — a swallowed failure there is a silently dropped transaction,
+    # the exact class the shed/typed-response contract forbids.
+    "mpi_blockchain_tpu/service",
 )
 
 _BROAD = ("Exception", "BaseException")

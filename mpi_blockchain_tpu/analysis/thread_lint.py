@@ -78,6 +78,8 @@ WAIT_SCOPE = (
     "mpi_blockchain_tpu/meshwatch/shard.py",
     "mpi_blockchain_tpu/meshwatch/pipeline.py",
     "mpi_blockchain_tpu/perfwatch/server.py",
+    "mpi_blockchain_tpu/service/mempool.py",
+    "mpi_blockchain_tpu/service/frontdoor.py",
 )
 
 #: file -> the seam that sanctions its wait sites, recorded per site in
@@ -96,6 +98,11 @@ WAIT_SEAMS = {
         "pipeline profiler ring lock (short critical sections)",
     "mpi_blockchain_tpu/perfwatch/server.py":
         "metrics server lifecycle (bounded close join)",
+    "mpi_blockchain_tpu/service/mempool.py":
+        "mempool heap/index lock (short critical sections, no IO held)",
+    "mpi_blockchain_tpu/service/frontdoor.py":
+        "template-feed lock + admission gate (handler-thread critical "
+        "sections; retries bounded by the `service` policy leash)",
 }
 _UNSANCTIONED = "unsanctioned — justify in the WAITBUDGET.json review"
 

@@ -43,7 +43,7 @@ BUNDLE_KEYS = (
     # incident overlay
     "rule", "severity", "detail", "heights", "incident_seq",
     "opened_at", "blocktrace", "skew_spans", "memory", "mesh",
-    "compiles",
+    "compiles", "service",
 )
 
 #: Bounded tails carried by a bundle (events/causal/spans come from
@@ -184,6 +184,7 @@ def build_bundle(record: dict) -> dict:
     from ..meshprof.memory import memory_snapshot
     from ..meshprof.spans import SKEW_TAIL_N, spans_tail
     from ..meshwatch.pipeline import profiler
+    from ..service import service_stats
     from ..telemetry import flight_recorder, mesh_rank
 
     heights = set(record.get("heights", ()))
@@ -215,5 +216,8 @@ def build_bundle(record: dict) -> dict:
                                          "world_size": int(os.environ.get(
                                              "MPIBT_MESH_WORLD", 1))},
         "compiles": compile_snapshot(),
+        # Blockserve door stats at fire time ({} on serviceless ranks):
+        # a mempool_saturation bundle carries the pool it indicts.
+        "service": service_stats(),
     })
     return payload

@@ -423,9 +423,52 @@ class RecompileStorm(Rule):
                                 for site, st in census.items()}}
 
 
+class MempoolSaturation(Rule):
+    """The blockserve admission surface under sustained overload: the
+    door shedding faster than ``shed_n`` requests between samples, or
+    the pool camping at/above ``full_frac`` of its capacity bound.
+    Transient spikes ride the standard debounce; the episode clears by
+    hysteresis once the pool drains and sheds stop. Serviceless
+    processes sample ``{}`` and never fire (the clean-mine
+    false-positive contract), and an idle door (no sheds, shallow pool)
+    never breaches. The incident detail carries the shed breakdown and
+    depth so the bundle's ``service`` snapshot has its headline."""
+
+    name = "mempool_saturation"
+    severity = "warn"
+
+    def __init__(self):
+        super().__init__()
+        self.shed_n = env_number("MPIBT_CHAINWATCH_MEMPOOL_SHED_N", 5,
+                                 cast=int, minimum=1)
+        self.full_frac = env_number("MPIBT_CHAINWATCH_MEMPOOL_FRAC", 0.95,
+                                    cast=float, minimum=0)
+        self._prev_shed = None
+
+    def sample(self, ctx):
+        from ..service import service_stats
+
+        stats = service_stats()
+        if not stats:
+            return False, {}
+        shed_total = sum((stats.get("shed_total") or {}).values())
+        prev, self._prev_shed = self._prev_shed, shed_total
+        pool = stats.get("mempool") or {}
+        depth, cap = int(pool.get("depth", 0)), int(pool.get("cap", 0))
+        full = cap > 0 and depth >= self.full_frac * cap
+        shed_delta = 0 if prev is None else shed_total - prev
+        if shed_delta < self.shed_n and not full:
+            return False, {}
+        return True, {"depth": depth, "cap": cap,
+                      "shed_delta": shed_delta,
+                      "shed_total": dict(stats.get("shed_total") or {}),
+                      "accept_gate": stats.get("accept_gate") or {},
+                      "full_frac": self.full_frac}
+
+
 def default_rules() -> list[Rule]:
     """Fresh instances of the full catalogue, evaluation order fixed
     (docs/observability.md §chainwatch documents each row)."""
     return [HashrateCollapse(), CollectiveSkewSpike(),
             HbmWatermarkGrowth(), StaleRank(), BubbleRegression(),
-            EventStorm(), RecompileStorm()]
+            EventStorm(), RecompileStorm(), MempoolSaturation()]

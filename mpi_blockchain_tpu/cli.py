@@ -194,6 +194,11 @@ def cmd_mine(args) -> int:
     cfg = _config_from(args)
     if args.verbose:
         get_logger().setLevel("DEBUG")
+    if args.serve is not None and args.fused:
+        raise ConfigError(
+            "--serve needs the per-block miner (drop --fused): the "
+            "template feed rebinds Miner.payload_for at block "
+            "boundaries, a seam the fused device loop never consults")
     world = None
     if args.elastic:
         if args.coordinator:
@@ -307,18 +312,40 @@ def cmd_mine(args) -> int:
     if args.profile:
         from .utils.profiling import trace_mining
         profile_ctx = trace_mining(args.profile)
+    service_state = service_summary = None
+    if args.serve is not None and is_main:
+        # Only the main rank opens the door: every process mines the
+        # identical chain, so N doors on one --serve port would just
+        # race the bind (and the mesh view already aggregates the one
+        # armed door through the shard `service` carriage).
+        from .service import install_service
+        service_state = install_service(miner, port=args.serve)
+        print(f"serving chain on http://127.0.0.1:"
+              f"{service_state.server.port} "
+              f"(/submit /tx_status /chain /template)",
+              file=sys.stderr, flush=True)
     t0 = time.perf_counter()
-    with profile_ctx:
-        if args.fused:
-            # The fused loop appends whole device spans; checkpoint at
-            # span boundaries (every span IS >= 1 block of progress).
-            def _fused_save(height):
-                with _profiler().segment_on_last("checkpoint"):
-                    _periodic_save(miner.node, args.checkpoint, cfg)
-            miner.mine_chain(remaining, on_progress=(
-                _fused_save if on_block is not None else None))
-        else:
-            miner.mine_chain(remaining, on_block=on_block)
+    try:
+        with profile_ctx:
+            if args.fused:
+                # The fused loop appends whole device spans; checkpoint
+                # at span boundaries (every span IS >= 1 block of
+                # progress).
+                def _fused_save(height):
+                    with _profiler().segment_on_last("checkpoint"):
+                        _periodic_save(miner.node, args.checkpoint, cfg)
+                miner.mine_chain(remaining, on_progress=(
+                    _fused_save if on_block is not None else None))
+            else:
+                miner.mine_chain(remaining, on_block=on_block)
+    finally:
+        if service_state is not None:
+            # Stats BEFORE teardown (the summary stamps them), and the
+            # door closes on every exit path — a crashed mine must not
+            # leave a live socket serving a dead miner.
+            from .service import uninstall_service
+            service_summary = service_state.stats()
+            uninstall_service(service_state)
     wall = time.perf_counter() - t0
     if not is_main:      # non-zero processes mine but stay silent
         return 0
@@ -342,6 +369,8 @@ def cmd_mine(args) -> int:
         summary.update(hashes_tried=miner.total_hashes(),
                        hashes_per_sec=round(miner.hashes_per_sec()),
                        backend=miner.backend.name)
+    if service_summary is not None:
+        summary["service"] = service_summary
     from .meshwatch.pipeline import pipeline_report
     from .telemetry.registry import default_registry as _default_registry
     pipe = pipeline_report()
@@ -656,6 +685,46 @@ def cmd_info(args) -> int:
     return 0
 
 
+def cmd_serve(args) -> int:
+    """Mine a chain WHILE serving the blockserve front door: submit /
+    tx_status / chain / template over HTTP (docs/serving.md). The
+    sugared form of `mine --serve` with the door knobs exposed; exits
+    when the chain reaches --blocks (run a large --blocks for a
+    long-lived door)."""
+    from .models.miner import Miner
+    from .service import (Mempool, TemplateFeed, install_service,
+                          uninstall_service)
+
+    cfg = _config_from(args)
+    miner = Miner(cfg)
+    mempool = Mempool(cap=args.mempool_cap)          # None -> env default
+    feed = TemplateFeed(mempool, cfg, max_txs=args.template_txs)
+    state = install_service(miner, port=args.port, host=args.host,
+                            mempool=mempool, feed=feed,
+                            deadline_s=args.deadline)
+    print(json.dumps({
+        "event": "service_started",
+        "url": f"http://{args.host}:{state.server.port}",
+        "endpoints": ["/submit", "/tx_status", "/chain", "/template",
+                      "/metrics", "/healthz", "/events"]},
+        sort_keys=True), file=sys.stderr, flush=True)
+    t0 = time.perf_counter()
+    try:
+        miner.mine_chain(cfg.n_blocks)
+    finally:
+        stats = state.stats()
+        uninstall_service(state)
+    print(json.dumps({
+        "event": "chain_served",
+        "height": miner.node.height,
+        "tip_hash": miner.node.tip_hash.hex(),
+        "wall_s": round(time.perf_counter() - t0, 3),
+        "backend": miner.backend.name,
+        "service": stats,
+    }, sort_keys=True))
+    return 0
+
+
 def cmd_bench(args) -> int:
     from .bench_lib import bench_chain, run_bench
 
@@ -719,6 +788,12 @@ def main(argv: list[str] | None = None) -> int:
                              "the mesh shrinks on suspicion (rank/world "
                              "from --process-id/--num-processes or "
                              "MPIBT_MESH_RANK/MPIBT_MESH_WORLD)")
+    p_mine.add_argument("--serve", metavar="PORT", type=int, default=None,
+                        help="open the blockserve front door on PORT "
+                             "(0 = ephemeral) while mining: /submit "
+                             "/tx_status /chain /template "
+                             "(docs/serving.md); incompatible with "
+                             "--fused")
     p_mine.add_argument("--events-dump", metavar="PATH", default=None,
                         help="with --elastic: write this rank's Lamport-"
                              "stamped causal log (mined blocks + "
@@ -767,6 +842,30 @@ def main(argv: list[str] | None = None) -> int:
     p_bench.add_argument("--blocks-per-call", type=int, default=100)
     _add_metrics_dump_arg(p_bench)
     p_bench.set_defaults(fn=cmd_bench)
+
+    p_serve = sub.add_parser(
+        "serve", help="mine while serving the blockserve HTTP front door "
+                      "(submit/tx_status/chain/template; docs/serving.md)")
+    _add_config_args(p_serve)
+    p_serve.add_argument("--port", type=int, default=0,
+                         help="door port (0 = ephemeral, announced on "
+                              "stderr)")
+    p_serve.add_argument("--host", default="127.0.0.1")
+    p_serve.add_argument("--deadline", type=float, default=None,
+                         metavar="SECONDS",
+                         help="per-request deadline (default "
+                              "MPIBT_SERVICE_DEADLINE, 5s): expired work "
+                              "is dropped before it reaches the miner")
+    p_serve.add_argument("--mempool-cap", type=int, default=None,
+                         metavar="N",
+                         help="bounded mempool capacity (default "
+                              "MPIBT_MEMPOOL_CAP, 512)")
+    p_serve.add_argument("--template-txs", type=int, default=None,
+                         metavar="N",
+                         help="max txs folded into one block template "
+                              "(default MPIBT_TEMPLATE_TXS, 16)")
+    _add_metrics_dump_arg(p_serve)
+    p_serve.set_defaults(fn=cmd_serve)
 
     p_sim = sub.add_parser(
         "sim", help="adversarial simulation: the config-5 partition+reorg "

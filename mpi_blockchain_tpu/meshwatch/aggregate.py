@@ -268,7 +268,7 @@ def mesh_health(directory, stall_s: float | None = None,
                      "missing_ranks": [],
                      "live_ranks": 0, "world_size": 0,
                      "skew": {}, "memory": {}, "incidents": [],
-                     "compiles": {}}
+                     "compiles": {}, "service": {}}
     status = rank_status(shards, stall_s=stall_s, now=now,
                          heartbeat_stall_s=heartbeat_stall_s)
     ranks = status["ranks"]
@@ -332,6 +332,9 @@ def mesh_health(directory, stall_s: float | None = None,
         # compile counts across ranks are the desync smell single-chip
         # CI can't reproduce — flagged here before the hang.
         "compiles": mesh_compiles(shards),
+        # Per-rank blockserve door stats (service carriage): mempool
+        # saturation and closed accept gates, {} on serviceless meshes.
+        "service": mesh_service(shards),
     }
     return (200 if healthy else 503), payload
 
@@ -375,6 +378,35 @@ def mesh_compiles(shards: list[dict]) -> dict:
                                    key=lambda kv: int(kv[0]))),
             "max": max(totals), "min": min(totals),
             "divergent": max(totals) != min(totals)}
+
+
+def mesh_service(shards: list[dict]) -> dict:
+    """Mesh-wide blockserve view off the shard ``service`` carriage:
+    per-rank door stats plus the mesh totals the saturation triage
+    reads first — summed mempool depth, summed sheds by reason, and
+    which ranks' accept gates are closed. ``{}`` when no rank carries a
+    door (the serviceless shape the schema pin fixes). Pure function —
+    ``/healthz`` shares it with tests."""
+    by_rank: dict[str, dict] = {}
+    for shard in shards:
+        svc = shard.get("service") or {}
+        if not svc:
+            continue
+        by_rank[str(int(shard["rank"]))] = svc
+    if not by_rank:
+        return {}
+    shed: dict[str, int] = {}
+    for svc in by_rank.values():
+        for reason, n in (svc.get("shed_total") or {}).items():
+            shed[reason] = shed.get(reason, 0) + int(n)
+    return {"by_rank": dict(sorted(by_rank.items(),
+                                   key=lambda kv: int(kv[0]))),
+            "depth": sum(int((v.get("mempool") or {}).get("depth", 0))
+                         for v in by_rank.values()),
+            "shed_total": dict(sorted(shed.items())),
+            "gates_closed": sorted(
+                int(r) for r, v in by_rank.items()
+                if not (v.get("accept_gate") or {}).get("open", True))}
 
 
 # ---- Prometheus rendering -------------------------------------------------

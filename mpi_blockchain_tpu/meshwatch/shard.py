@@ -35,7 +35,10 @@ seconds (default 1.0) and once more at close. The shard carries:
 * ``compiles`` — the rank's dispatchwatch compile snapshot (per-site
   census + event tail; ``{}`` on ranks that never observed a compile),
   so divergent per-rank compile counts surface in ``mesh_health``
-  before the desync hang they precede.
+  before the desync hang they precede;
+* ``service`` — the rank's blockserve door stats (mempool depth, shed
+  totals, accept-gate state; ``{}`` on serviceless ranks), so the mesh
+  ``/healthz`` can show saturation and closed doors per rank.
 
 Wall-clock timestamps are deliberate here (unlike the causal logs):
 staleness is a wall-clock question, and shards never participate in the
@@ -127,6 +130,7 @@ class ShardWriter:
         from ..dispatchwatch import compile_snapshot
         from ..meshprof.memory import memory_snapshot
         from ..meshprof.spans import SKEW_TAIL_N, spans_tail
+        from ..service import service_stats
         from .pipeline import profiler
 
         # The shard-flush tick is one of chainwatch's two sanctioned
@@ -171,6 +175,10 @@ class ShardWriter:
             # on cold-backend ranks) so mesh_health can flag divergent
             # per-rank compile counts before the desync hang.
             "compiles": compile_snapshot(),
+            # Blockserve door stats ({} on serviceless ranks): mempool
+            # depth, shed totals and accept-gate state ride to the mesh
+            # aggregator's /healthz `service` view.
+            "service": service_stats(),
         }
 
     # ---- writing ---------------------------------------------------------

@@ -213,6 +213,21 @@ class Miner:
         """Runs right after a block's append, in both drivers — the
         elastic causal-record seam."""
 
+    def payload_for(self, height: int) -> bytes:
+        """The template-feed seam: the payload the candidate at
+        ``height`` embeds. Both drivers route every payload through
+        this ONE hook (the sequential oracle's default-data path, the
+        pipelined block boundary, and the speculative next-block
+        dispatch), so a template service can swap the fixed
+        ``config.payload`` for a live mempool-built template per
+        instance. The pipelined driver re-validates the speculative
+        candidate against a FRESH ``payload_for`` read at the next
+        block boundary (``_speculation_valid`` byte-compares), so a
+        template rebuilt between blocks simply turns the stale
+        speculation into a "restripe" discard + re-dispatch — the
+        mined block always embeds the boundary-time template."""
+        return self.config.payload(height)
+
     # ---- the sequential oracle --------------------------------------------
 
     def mine_block(self, data: bytes | None = None) -> BlockRecord:
@@ -232,7 +247,7 @@ class Miner:
         height = self.node.height + 1
         self._begin_block(height)
         if data is None:
-            data = self.config.payload(height)
+            data = self.payload_for(height)
         backend = self.backend.name
         t0 = time.perf_counter()
         tried = 0
@@ -529,7 +544,7 @@ class Miner:
         the marginal per-block wall."""
         height = self.node.height + 1
         self._begin_block(height)
-        data = self.config.payload(height)
+        data = self.payload_for(height)
         windows = _WindowSet(self.search_windows())
         if windows.get(0) is None:
             self._discard_speculative(pending, "error")
@@ -618,7 +633,7 @@ class Miner:
                 # so an exception anywhere between here and the next
                 # block boundary (a submit failure, an on_block error)
                 # can never orphan it with its height stamps intact.
-                nh, ndata = height + 1, self.config.payload(height + 1)
+                nh, ndata = height + 1, self.payload_for(height + 1)
                 nd = self._issue_sweep(
                     nh, 0, windows, 0,
                     lambda: core.make_candidate_header(

@@ -68,9 +68,15 @@ SECTION_FLOOR_PCT = {"cpu_np8": 60.0, "sim_adversarial": 60.0}
 # its seam cache and is reused forever after; ANY post-warmup recompile
 # is trace-cache churn (the runtime twin of the SHD003 divergent-trace
 # class), never weather.
+# serve bounds the serve smoke's p99 submit latency (ms) over loopback
+# while a live miner consumes the rebuilt templates (`make serve-smoke`,
+# service/__main__). 2000 ms is deliberately generous — per-request
+# admission is microseconds of host work, so the bound catches a wedged
+# or queueing door (the exact overload failure the admission contract
+# forbids), never shared-box scheduler weather.
 SECTION_BOUNDS = {"trace_overhead": 3.0, "trace_block_observe": 300.0,
                   "pipeline_bubble": 0.15, "collective_skew": 10000.0,
-                  "compile_cache": 0.0}
+                  "compile_cache": 0.0, "serve": 2000.0}
 
 
 @dataclasses.dataclass(frozen=True)

@@ -45,6 +45,13 @@ wall-clock, lower is better):
                     once into its seam cache; the payload also carries
                     the per-site census and the HLO measured-cost
                     cross-check vs the committed OPBUDGET census
+    serve           p99_latency_ms of the chaos-gated serve smoke's
+                    live-mine load phase (`make serve-smoke`,
+                    service/__main__) — SECTION_BOUNDS caps it at
+                    2000 ms (generous: the bound catches a wedged door,
+                    not loopback scheduler weather); the payload also
+                    carries requests_per_sec, shed_fraction and the
+                    mempool high-water depth
 
 Seeding: ``seed_from_bench_rounds`` imports the repo's existing
 ``BENCH_r0*.json`` round records (fresh measurements only — ``cached``
@@ -78,6 +85,7 @@ SECTION_METRICS: dict[str, tuple[str, str | None]] = {
     "pipeline_bubble": ("bubble_fraction", None),
     "collective_skew": ("max_skew_ms", None),
     "compile_cache": ("recompiles_after_warmup", None),
+    "serve": ("p99_latency_ms", None),
 }
 
 _KEY_FIELDS = ("preset", "kernel", "mesh", "backend")

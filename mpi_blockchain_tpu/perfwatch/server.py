@@ -176,6 +176,7 @@ class MetricsServer:
         503 otherwise — with per-heartbeat detail so the stalled layer
         is named, not guessed.
         """
+        from ..service import service_stats
         from ..telemetry import heartbeat_snapshot
 
         beats = heartbeat_snapshot(self.registry())
@@ -200,6 +201,9 @@ class MetricsServer:
             "last_progress_age_s": (None if freshest is None
                                     else round(freshest, 3)),
             "heartbeats": beats,
+            # Additive: the armed blockserve door's mempool depth, shed
+            # totals and accept-gate state ({} while no service runs).
+            "service": service_stats(),
         }
 
     def events_tail(self, n: int | None,

@@ -60,6 +60,28 @@ SITES = (
     #                           SIGKILL) while every survivor evicts it
     #                           at the same step (raise/hang crash the
     #                           step as usual)
+    "service.submit",         # service/frontdoor.ServiceState.submit,
+    #                           per admission attempt: raise/hang are
+    #                           retried under the service budget and
+    #                           shed TYPED on exhaustion (hang is a
+    #                           real-seconds wedge bounded by
+    #                           FaultTimeout — the door answers late,
+    #                           never never); corrupt rejects the tx as
+    #                           integrity-damaged before it can enter
+    #                           the mempool; partial admits the tx but
+    #                           loses the receipt (client recovers via
+    #                           tx_status — the accepted-then-lost
+    #                           conservation check)
+    "service.rebuild",        # service/frontdoor.TemplateFeed.rebuild,
+    #                           per template rebuild: raise/hang are
+    #                           retried and on exhaustion the PREVIOUS
+    #                           template keeps serving (degrade, never
+    #                           drop); corrupt damages the rebuilt
+    #                           template so the block-boundary
+    #                           re-validation discards it like a stale
+    #                           speculation; partial rebuilds from only
+    #                           a prefix of the eligible txs (the rest
+    #                           stay pending — delayed, never lost)
 )
 
 KINDS = ("raise", "hang", "corrupt", "partial")

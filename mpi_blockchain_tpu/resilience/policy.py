@@ -70,6 +70,13 @@ _PER_SITE: dict[str, RetryPolicy] = {
     # give up loudly, not camp on a fabric that keeps wedging.
     "mesh.rebuild": RetryPolicy(max_attempts=2, base_backoff_s=0.05,
                                 max_backoff_s=0.5),
+    # The serving front door ("service" covers service.submit and
+    # service.rebuild via the layer-prefix fallback): a tight leash
+    # with near-zero backoff — a request holds an HTTP handler thread
+    # while it retries, so the budget must resolve well inside the
+    # per-request deadline and shed typed rather than camp.
+    "service": RetryPolicy(max_attempts=2, base_backoff_s=0.005,
+                           max_backoff_s=0.02),
 }
 _DEFAULT = RetryPolicy()
 

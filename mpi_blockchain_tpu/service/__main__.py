@@ -1,0 +1,315 @@
+"""blockserve CLI: the chaos-gated serve smoke + seeded loadgen.
+
+``smoke`` is the ``make serve-smoke`` gate (ISSUE 20 acceptance). One
+in-process world, fully deterministic where it must be:
+
+1. a STRICT fault plan arms ``service.submit`` (hang) and
+   ``service.rebuild`` (raise) — both must fire or the run fails;
+2. a fee-paying seeded load batch hits a live door over real HTTP while
+   a pipelined miner mines against the rebuilt templates, with the
+   ResilientBackend's top rung rigged to die mid-run (the forced
+   step-down: the door must stamp ``degraded`` and keep serving);
+3. the hard, non-weather assertions: every request answers (no hangs,
+   max latency inside the deadline budget), every non-2xx carries a
+   typed ``shed_reason``, every accepted/receipt-lost tx is
+   status-queryable afterwards (zero accepted-then-lost), admission
+   conservation holds against the pool bound, and the mined chain is
+   byte-identical to a sequential no-service oracle replaying the
+   recorded per-height templates;
+4. the ``serve`` bench payload (requests/s, p99 latency, shed fraction,
+   mempool high-water) is judged against SECTION_BOUNDS through the
+   perfwatch detector (``--record`` appends it to PERF_HISTORY.jsonl —
+   the measure -> gate -> record shape).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+import threading
+
+SMOKE_SEED = 1337
+SMOKE_DIFFICULTY = 12
+SMOKE_BLOCKS = 6
+SMOKE_CAP = 8
+SMOKE_TEMPLATE_TXS = 4
+SMOKE_BATCH_A = 10     # pre-mine: exercises admission + the hang fault
+SMOKE_BATCH_B = 16     # streamed while the miner runs
+SMOKE_DEADLINE_S = 5.0
+
+
+class _FlakyRung:
+    """A cpu backend whose dispatches start failing after
+    ``fail_after`` calls and never recover — exhausts the dispatch
+    retry budget and forces the ladder's mid-run step-down. Until the
+    failure it delegates verbatim, so both rungs compute identical
+    sweeps and the chain stays byte-identical across the step-down."""
+
+    name = "cpu-flaky"
+
+    def __init__(self, fail_after: int):
+        from ..backend.cpu import CpuBackend
+        self._inner = CpuBackend()
+        self._calls = 0
+        self._fail_after = fail_after
+
+    def search(self, header80, difficulty_bits, start_nonce=0,
+               max_count=1 << 32):
+        self._calls += 1
+        if self._calls > self._fail_after:
+            raise RuntimeError(
+                f"flaky rung wedged (call {self._calls})")
+        return self._inner.search(header80, difficulty_bits,
+                                  start_nonce, max_count)
+
+
+def _smoke_world():
+    """(miner, state) — the served world under the rigged ladder."""
+    from ..backend.cpu import CpuBackend
+    from ..config import MinerConfig
+    from ..models.miner import Miner
+    from ..resilience.dispatch import ResilientBackend
+    from . import install_service
+
+    cfg = MinerConfig(difficulty_bits=SMOKE_DIFFICULTY,
+                      n_blocks=SMOKE_BLOCKS, backend="cpu",
+                      seed=SMOKE_SEED)
+    ladder = ResilientBackend(
+        [("cpu-flaky", lambda: _FlakyRung(fail_after=3)),
+         ("cpu", CpuBackend)], seed=SMOKE_SEED)
+    miner = Miner(cfg, backend=ladder, pipeline=True)
+    from .mempool import Mempool
+    from .frontdoor import TemplateFeed
+    pool = Mempool(cap=SMOKE_CAP)
+    feed = TemplateFeed(pool, cfg, max_txs=SMOKE_TEMPLATE_TXS)
+    state = install_service(miner, port=0, mempool=pool, feed=feed,
+                            deadline_s=SMOKE_DEADLINE_S)
+    return miner, state
+
+
+def cmd_smoke(args) -> int:
+    import logging
+
+    from ..perfwatch.detector import check_candidate
+    from ..perfwatch.history import DEFAULT_HISTORY_NAME, HistoryStore
+    from ..perfwatch.server import wait_listening
+    from ..resilience import FaultPlanError, injection
+    from ..resilience.faultplan import FaultPlan, FaultSpec
+    from . import uninstall_service
+    from .loadgen import run_load
+    from .mempool import txid_of
+
+    logging.getLogger("mpi_blockchain_tpu").setLevel(logging.WARNING)
+    repo_root = pathlib.Path(__file__).resolve().parent.parent.parent
+    store = HistoryStore(repo_root / DEFAULT_HISTORY_NAME)
+
+    plan = FaultPlan(faults=(
+        FaultSpec(site="service.submit", kind="hang", call=2, times=1,
+                  seconds=0.05),
+        FaultSpec(site="service.rebuild", kind="raise", call=0, times=1),
+    ), seed=SMOKE_SEED, strict=True)
+    injection.arm(plan)
+    miner, state = _smoke_world()
+    failures: list[str] = []
+    try:
+        base_url = state.server.url("/").rstrip("/")
+        if not wait_listening(state.server.host, state.server.port):
+            print("serve-smoke: door never started listening",
+                  file=sys.stderr)
+            return 1
+
+        # Phase A — pre-mine admission under faults: the strict hang
+        # fires here (call index 2), the rebuild raise fired at bind.
+        report_a = run_load(base_url, seed=SMOKE_SEED, n=SMOKE_BATCH_A,
+                            workers=2, mempool_probe=state.mempool.depth)
+
+        # Phase B — live serving: stream submits while the pipelined
+        # miner mines the rebuilt templates and the rigged rung dies.
+        report_b: dict = {}
+
+        def _stream():
+            report_b.update(run_load(
+                base_url, seed=SMOKE_SEED + 1, n=SMOKE_BATCH_B,
+                workers=2, mempool_probe=state.mempool.depth))
+
+        streamer = threading.Thread(target=_stream, name="serve-stream",
+                                    daemon=True)
+        streamer.start()
+        miner.mine_chain(SMOKE_BLOCKS)
+        streamer.join(timeout=60)
+        if streamer.is_alive():
+            failures.append("loadgen stream never finished (a hung "
+                            "request escaped its deadline)")
+
+        # ---- hard gates (none of these are weather) ----------------------
+        for tag, rep in (("A", report_a), ("B", report_b)):
+            if rep.get("untyped_sheds", 1):
+                failures.append(f"phase {tag}: non-2xx response without "
+                                f"a shed_reason: {rep.get('by_outcome')}")
+            if rep.get("errors", 1):
+                failures.append(f"phase {tag}: transport errors: "
+                                f"{rep.get('by_outcome')}")
+            if rep.get("requests") != (SMOKE_BATCH_A if tag == "A"
+                                       else SMOKE_BATCH_B):
+                failures.append(f"phase {tag}: not every request "
+                                f"answered: {rep.get('requests')}")
+            if rep.get("max_latency_ms", 1e9) >= SMOKE_DEADLINE_S * 1e3:
+                failures.append(f"phase {tag}: request latency "
+                                f"{rep.get('max_latency_ms')}ms breached "
+                                f"the deadline budget")
+
+        # Zero accepted-then-lost: every admitted (or receipt-lost) tx
+        # must still be status-queryable through the live door.
+        import urllib.request
+        lost = []
+        for rep in (report_a, report_b):
+            for txid in rep.get("accepted_txids", []):
+                with urllib.request.urlopen(
+                        f"{base_url}/tx_status?txid={txid}",
+                        timeout=5) as resp:
+                    if resp.status != 200:
+                        lost.append(txid)
+        # Receipt-lost txs carried no txid over the wire: recompute it
+        # from the schedule payload — the tx WAS admitted (the partial
+        # fault loses only the receipt), so its status must still
+        # answer through the door.
+        for rep in (report_a, report_b):
+            for res_payload in rep.get("receipt_lost_payloads", []):
+                tid = txid_of(res_payload.encode())
+                code, _ = state.tx_status(tid)
+                if code != 200:
+                    lost.append(tid)
+        if lost:
+            failures.append(f"accepted-then-lost txids: {lost}")
+
+        # Admission conservation: 10 unique submits into a cap-8 pool
+        # must displace or shed at least 2 — and everything admitted is
+        # accounted pending/included/evicted/expired.
+        snap = state.mempool.snapshot()
+        displaced = (sum(report_a["shed_reasons"].values())
+                     + snap["evicted_total"])
+        if displaced < SMOKE_BATCH_A - SMOKE_CAP:
+            failures.append(f"admission conservation broke: "
+                            f"{displaced} displaced/shed for "
+                            f"{SMOKE_BATCH_A} submits into cap "
+                            f"{SMOKE_CAP}: {snap}")
+        if snap["included_total"] < 1:
+            failures.append(f"no submitted tx was ever mined into a "
+                            f"template: {snap}")
+
+        # The forced step-down: degraded stamp + reads stay up.
+        if not miner.backend.degraded:
+            failures.append("rigged ladder never stepped down")
+        tmpl = state.template_view()
+        if not tmpl.get("degraded"):
+            failures.append(f"template response missing the degraded "
+                            f"stamp: {tmpl}")
+        chain = state.chain_view(n=SMOKE_BLOCKS)
+        if chain["height"] != SMOKE_BLOCKS:
+            failures.append(f"served chain height {chain['height']} != "
+                            f"{SMOKE_BLOCKS}")
+
+        # Byte-identity vs the sequential no-service oracle.
+        recorded = dict(state.feed.history)
+        from ..backend.cpu import CpuBackend
+        from ..config import MinerConfig
+        from ..models.miner import Miner
+        oracle = Miner(MinerConfig(difficulty_bits=SMOKE_DIFFICULTY,
+                                   n_blocks=SMOKE_BLOCKS, backend="cpu",
+                                   seed=SMOKE_SEED),
+                       backend=CpuBackend(), pipeline=False)
+        oracle.payload_for = lambda h: recorded[h]
+        oracle.mine_chain(SMOKE_BLOCKS)
+        if oracle.chain_hashes() != miner.chain_hashes():
+            failures.append("served chain diverged from the sequential "
+                            "no-service oracle")
+        chain_identical = oracle.chain_hashes() == miner.chain_hashes()
+
+        # Strict plan exhaustion: both injected faults actually fired.
+        try:
+            injection.disarm(strict=True)
+        except FaultPlanError as e:
+            failures.append(str(e))
+
+        # ---- the serve bench payload, gated like every section -----------
+        payload = {
+            "backend": "cpu",
+            "difficulty_bits": SMOKE_DIFFICULTY,
+            "n_blocks": SMOKE_BLOCKS,
+            "requests": report_b.get("requests", 0),
+            "requests_per_sec": report_b.get("requests_per_sec", 0.0),
+            "p99_latency_ms": report_b.get("p99_latency_ms", 0.0),
+            "shed_fraction": report_b.get("shed_fraction", 0.0),
+            "mempool_depth_max": max(
+                report_a.get("mempool_depth_max", 0),
+                report_b.get("mempool_depth_max", 0)),
+            "mempool_cap": SMOKE_CAP,
+            "included_total": snap["included_total"],
+            "chain_identical": chain_identical,
+        }
+        finding = check_candidate(store, "serve", payload)
+        if finding.verdict == "regression":
+            failures.append(f"serve bench over budget: "
+                            f"{finding.render()}")
+        if failures:
+            for f in failures:
+                print(f"serve-smoke: {f}", file=sys.stderr)
+            return 1
+        if args.record:
+            store.record("serve", payload, source="serve-smoke")
+        print(json.dumps({
+            "event": "serve_smoke", "ok": True,
+            "faults_fired": 2,
+            "degraded_to": miner.backend.rung,
+            "sheds": dict(state.shed_totals),
+            "verdict": finding.verdict,
+            **payload}, sort_keys=True))
+        return 0
+    finally:
+        injection.disarm()
+        from . import active_service
+        if active_service() is state:
+            uninstall_service(state)
+
+
+def cmd_loadgen(args) -> int:
+    from .loadgen import run_load
+
+    report = run_load(args.url, seed=args.seed, n=args.requests,
+                      workers=args.workers)
+    report.pop("accepted_txids", None)
+    print(json.dumps(report, sort_keys=True))
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m mpi_blockchain_tpu.service",
+        description="blockserve front door: chaos-gated serve smoke + "
+                    "seeded load generator")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p_smoke = sub.add_parser(
+        "smoke", help="the make serve-smoke gate: faulted, degraded, "
+                      "oracle-checked serving")
+    p_smoke.add_argument("--record", action="store_true",
+                         help="append the serve bench payload to "
+                              "PERF_HISTORY.jsonl on success")
+    p_smoke.set_defaults(fn=cmd_smoke)
+
+    p_load = sub.add_parser("loadgen", help="drive a seeded submit load "
+                                            "at a live door")
+    p_load.add_argument("--url", required=True,
+                        help="door base URL, e.g. http://127.0.0.1:9100")
+    p_load.add_argument("--seed", type=int, default=0)
+    p_load.add_argument("--requests", type=int, default=32)
+    p_load.add_argument("--workers", type=int, default=2)
+    p_load.set_defaults(fn=cmd_loadgen)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -118,7 +118,7 @@ def test_default_rules_catalogue_shape():
     assert names == ["hashrate_collapse", "collective_skew_spike",
                      "hbm_watermark_growth", "stale_rank",
                      "bubble_regression", "event_storm",
-                     "recompile_storm"]
+                     "recompile_storm", "mempool_saturation"]
     assert all(r.severity in SEVERITIES for r in rules)
     assert {r.name: r.severity for r in rules}["hashrate_collapse"] \
         == "critical"

@@ -342,8 +342,8 @@ def test_shard_payload_carries_skew_spans_and_memory(tmp_path):
 
 def test_mesh_health_payload_schema_pin():
     """The /healthz schema: every pre-existing key unchanged, plus the
-    additive meshprof `skew`/`memory`, chainwatch `incidents` and
-    dispatchwatch `compiles` fields."""
+    additive meshprof `skew`/`memory`, chainwatch `incidents`,
+    dispatchwatch `compiles` and blockserve `service` fields."""
     spans0 = [span("block.step", i, 1000.0 + i) for i in range(3)]
     spans1 = [span("block.step", i, 1000.0 + i + 0.002 * (i % 2))
               for i in range(3)]
@@ -360,9 +360,10 @@ def test_mesh_health_payload_schema_pin():
                            "heartbeat_stall_s", "live_ranks",
                            "stale_ranks", "failed_ranks", "missing_ranks",
                            "ranks", "skew", "memory", "incidents",
-                           "compiles"}
+                           "compiles", "service"}
     assert health["incidents"] == []
     assert health["compiles"] == {}     # no shard carried a census
+    assert health["service"] == {}      # no shard carried a door
     assert health["skew"]["sites"]["block.step"]["straggler_rank"] == 1
     assert health["memory"] == {"0": {"dev0": {"bytes_in_use": 7}}}
 

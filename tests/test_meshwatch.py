@@ -677,6 +677,43 @@ def test_mesh_health_incidents_key_is_additive(tmp_path):
             - {"stall_s", "heartbeat_stall_s"}) <= set(empty)
 
 
+def test_mesh_health_service_key_is_additive(tmp_path):
+    # Shards written before blockserve existed carry no `service` key:
+    # the aggregate must still emit the key ({} — the serviceless
+    # shape) while every pre-existing key keeps its shape; same
+    # additive contract the `incidents`/`compiles` carriages hold.
+    code, health = mesh_health(
+        tmp_path, stall_s=5.0,
+        shards=[_shard(0, final=False), _shard(1, final=False)])
+    assert code == 200
+    assert HEALTHZ_BASE_KEYS <= set(health)
+    assert health["service"] == {}
+    _, empty = mesh_health(tmp_path / "void", stall_s=5.0)
+    assert empty["service"] == {}
+
+
+def test_mesh_service_merges_rank_doors(tmp_path):
+    from mpi_blockchain_tpu.meshwatch.aggregate import mesh_service
+
+    svc0 = {"mempool": {"depth": 3, "cap": 8},
+            "shed_total": {"mempool_full": 2},
+            "accept_gate": {"open": True}}
+    svc1 = {"mempool": {"depth": 5, "cap": 8},
+            "shed_total": {"mempool_full": 1, "deadline": 4},
+            "accept_gate": {"open": False, "reason": "miner_stalled"}}
+    shards = [{**_shard(0, final=False), "service": svc0},
+              {**_shard(1, final=False), "service": svc1},
+              _shard(2, final=False)]     # serviceless rank: skipped
+    out = mesh_service(shards)
+    assert sorted(out["by_rank"]) == ["0", "1"]
+    assert out["depth"] == 8
+    assert out["shed_total"] == {"deadline": 4, "mempool_full": 3}
+    assert out["gates_closed"] == [1]
+    # /healthz carries the same merged view.
+    code, health = mesh_health(tmp_path, stall_s=5.0, shards=shards)
+    assert health["service"] == out
+
+
 def test_mesh_health_carries_rank_stamped_incidents(tmp_path):
     inc = {"rule": "event_storm", "severity": "warn", "detail": {},
            "heights": [4], "incident_seq": 1,

@@ -1,0 +1,485 @@
+"""blockserve front-door tests: the ISSUE 20 robustness surface.
+
+Covers the admission contract (bounded fee-ordered mempool: ordering,
+capacity, displacement eviction), the deadline discipline (expired work
+dropped BEFORE the miner, never clawed back after), the typed shed
+bodies per reason, the heartbeat backpressure gate, template rebuild
+re-validation at block boundaries (corrupt/partial/raise fault kinds on
+both registered sites), loadgen schedule determinism, and the `serve`
+bench payload against its absolute SECTION_BOUNDS budget.
+"""
+import json
+import pathlib
+import urllib.request
+
+import pytest
+
+from mpi_blockchain_tpu import telemetry
+from mpi_blockchain_tpu.backend.cpu import CpuBackend
+from mpi_blockchain_tpu.config import MinerConfig
+from mpi_blockchain_tpu.models.miner import Miner
+from mpi_blockchain_tpu.resilience import injection
+from mpi_blockchain_tpu.resilience.faultplan import (KINDS, SITES,
+                                                     FaultPlan, FaultSpec)
+from mpi_blockchain_tpu.service import (Mempool, ServiceState, TemplateFeed,
+                                        active_service, install_service,
+                                        service_stats, template_payload,
+                                        txid_of, uninstall_service)
+from mpi_blockchain_tpu.service.mempool import (EVICTED, EXPIRED, INCLUDED,
+                                                PENDING)
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(autouse=True)
+def fresh_state():
+    telemetry.reset()
+    telemetry.clear_events()
+    injection.disarm()
+    yield
+    state = active_service()
+    if state is not None:
+        uninstall_service(state)
+    injection.disarm()
+    telemetry.reset()
+    telemetry.clear_events()
+
+
+def _cfg(**kw):
+    kw.setdefault("difficulty_bits", 10)
+    kw.setdefault("n_blocks", 2)
+    kw.setdefault("backend", "cpu")
+    kw.setdefault("seed", 7)
+    return MinerConfig(**kw)
+
+
+def _plan(*faults, **kw):
+    kw.setdefault("seed", 0)
+    return FaultPlan(faults=tuple(faults), **kw)
+
+
+# ---- mempool: ordering, capacity, eviction ------------------------------
+
+
+def test_mempool_take_is_fee_ordered_admission_tiebroken():
+    pool = Mempool(cap=8)
+    for payload, fee in ((b"a", 5), (b"b", 9), (b"c", 5), (b"d", 1)):
+        outcome, _ = pool.submit(payload, fee)
+        assert outcome == "accepted"
+    got = [t.payload for t in pool.take(4)]
+    # highest fee first; equal fees break by admission order (a then c).
+    assert got == [b"b", b"a", b"c", b"d"]
+    # take() does not consume: the same order reproduces.
+    assert [t.payload for t in pool.take(4)] == got
+    assert [t.payload for t in pool.take(2)] == [b"b", b"a"]
+
+
+def test_mempool_capacity_sheds_or_displaces():
+    pool = Mempool(cap=2)
+    _, low = pool.submit(b"low", 1)
+    pool.submit(b"mid", 5)
+    # equal-or-lower fee than the cheapest pending: shed, not queued.
+    assert pool.submit(b"equal", 1) == ("shed", None)
+    assert pool.depth() == 2
+    # strictly higher fee displaces the cheapest pending tx.
+    outcome, rec = pool.submit(b"rich", 9)
+    assert outcome == "accepted"
+    assert pool.depth() == 2
+    assert low.status == EVICTED
+    assert pool.status(low.txid).public()["status"] == EVICTED
+    assert pool.evicted_total == 1
+    assert [t.payload for t in pool.take(4)] == [b"rich", b"mid"]
+    # the displaced txid stays status-queryable after resolution.
+    assert pool.status(low.txid) is not None
+
+
+def test_mempool_duplicate_is_idempotent():
+    pool = Mempool(cap=4)
+    _, first = pool.submit(b"x", 3)
+    outcome, rec = pool.submit(b"x", 3)
+    assert outcome == "duplicate" and rec is first
+    assert pool.depth() == 1
+    assert pool.submitted_total == 1
+
+
+def test_mempool_cap_zero_sheds_everything():
+    pool = Mempool(cap=0)
+    assert pool.submit(b"any", 100) == ("shed", None)
+    assert pool.depth() == 0
+
+
+# ---- deadlines: dropped before the miner, never after -------------------
+
+
+def test_deadline_enforced_at_take_before_not_after():
+    pool = Mempool(cap=4, clock=lambda: 0.0)
+    _, rec = pool.submit(b"t", 5, deadline_s=1.0, now=0.0)
+    # before the deadline: the tx rides the template drain.
+    assert [t.txid for t in pool.take(4, now=0.5)] == [rec.txid]
+    assert rec.status == PENDING
+    # past the deadline: dropped HERE, before it can reach a template.
+    assert pool.take(4, now=1.5) == []
+    assert rec.status == EXPIRED and rec.reason == "deadline"
+    assert pool.expired_total == 1 and pool.depth() == 0
+
+
+def test_inclusion_truth_beats_lapsed_deadline():
+    # A tx already embedded in a dispatched template stays mined even if
+    # its deadline lapsed while the block was in flight: mark_included
+    # overrides EXPIRED — the chain's truth wins, nothing is clawed back.
+    pool = Mempool(cap=4)
+    _, rec = pool.submit(b"t", 5, deadline_s=0.5, now=0.0)
+    pool.take(4, now=2.0)
+    assert rec.status == EXPIRED
+    assert pool.mark_included([rec.txid], height=3) == 1
+    assert rec.status == INCLUDED and rec.height == 3
+    assert rec.public() == {"txid": rec.txid, "fee": 5, "size": 1,
+                            "status": INCLUDED, "height": 3}
+
+
+# ---- template feed: rebuilds + block-boundary re-validation -------------
+
+
+def test_template_payload_without_txs_is_config_payload():
+    cfg = _cfg()
+    for h in (0, 1, 7):
+        assert template_payload(cfg, h, ()) == cfg.payload(h)
+
+
+def test_corrupt_rebuild_discarded_at_block_boundary():
+    cfg = _cfg()
+    pool = Mempool(cap=4)
+    feed = TemplateFeed(pool, cfg, max_txs=4)
+    _, rec = pool.submit(b"tx", 5)
+    injection.arm(_plan(FaultSpec(site="service.rebuild", kind="corrupt")))
+    assert feed.rebuild()           # damaged template lands...
+    injection.disarm()
+    # ...and the boundary read discards it like a stale speculation,
+    # reverting to the last known-good (empty) template.
+    assert feed.payload_for(1) == cfg.payload(1)
+    assert feed.corrupt_discards == 1
+    # a clean rebuild then serves the tx at the next boundary.
+    assert feed.rebuild()
+    assert rec.txid in feed.payload_for(2).decode()
+
+
+def test_rebuild_raise_exhaustion_keeps_previous_template():
+    cfg = _cfg()
+    pool = Mempool(cap=4)
+    feed = TemplateFeed(pool, cfg, max_txs=4)
+    pool.submit(b"tx-a", 5)
+    assert feed.rebuild()
+    txids, seq = feed.current()
+    assert len(txids) == 1
+    pool.submit(b"tx-b", 9)
+    # the service retry budget is 2 attempts: fault both of them.
+    injection.arm(_plan(FaultSpec(site="service.rebuild", kind="raise",
+                                  times=-1)))
+    assert not feed.rebuild()       # degrade, never drop:
+    injection.disarm()
+    assert feed.current() == (txids, seq)   # previous template serves on
+    assert feed.rebuild_failures == 1
+    # tx-b was delayed, never lost: the next good rebuild embeds it.
+    assert feed.rebuild()
+    assert len(feed.current()[0]) == 2
+
+
+def test_partial_rebuild_keeps_rest_pending():
+    cfg = _cfg()
+    pool = Mempool(cap=4)
+    feed = TemplateFeed(pool, cfg, max_txs=4)
+    pool.submit(b"tx-a", 9)
+    pool.submit(b"tx-b", 5)
+    injection.arm(_plan(FaultSpec(site="service.rebuild", kind="partial")))
+    assert feed.rebuild()
+    injection.disarm()
+    (tid,), _ = feed.current()
+    assert tid == txid_of(b"tx-a")          # the fee-ordered prefix
+    assert pool.depth() == 2                # the rest stays pending
+
+
+def test_note_block_marks_included_and_drops_from_next_template():
+    cfg = _cfg()
+    pool = Mempool(cap=4)
+    feed = TemplateFeed(pool, cfg, max_txs=4)
+    _, rec = pool.submit(b"tx", 5)
+    feed.rebuild()
+    data = feed.payload_for(1)
+    assert rec.txid in data.decode()
+    feed.note_block(1)
+    assert rec.status == INCLUDED and rec.height == 1
+    assert feed.payload_for(2) == cfg.payload(2)
+
+
+# ---- admission control: typed sheds, gate, fault matrix -----------------
+
+
+def _state(miner=None, **kw):
+    miner = miner if miner is not None else Miner(_cfg(),
+                                                 backend=CpuBackend())
+    kw.setdefault("mempool", Mempool(cap=4))
+    return ServiceState(miner, **kw)
+
+
+def test_shed_bodies_are_typed_mempool_full():
+    state = _state(mempool=Mempool(cap=0))
+    code, body = state.submit(b"tx", 5)
+    assert code == 429
+    assert body["error"] == "shed"
+    assert body["shed_reason"] == "mempool_full"
+    assert body["retry_after_s"] > 0
+    assert state.shed_totals == {"mempool_full": 1}
+
+
+def test_shed_bodies_are_typed_queue_depth():
+    state = _state(max_inflight=0)
+    code, body = state.submit(b"tx", 5)
+    assert (code, body["shed_reason"]) == (503, "queue_depth")
+
+
+def test_submit_fault_matrix():
+    # raise past the retry budget: typed 503, the tx never entered.
+    state = _state()
+    injection.arm(_plan(FaultSpec(site="service.submit", kind="raise",
+                                  times=-1)))
+    code, body = state.submit(b"tx", 5)
+    assert (code, body["shed_reason"]) == (503, "retry_exhausted")
+    assert state.mempool.depth() == 0
+    injection.disarm()
+    # hang once: the retry answers late, never never — and admits.
+    injection.arm(_plan(FaultSpec(site="service.submit", kind="hang",
+                                  seconds=0.01)))
+    code, body = state.submit(b"tx", 5)
+    assert (code, body["result"]) == (200, "accepted")
+    injection.disarm()
+    # corrupt: integrity-damaged in flight, rejected before the pool.
+    injection.arm(_plan(FaultSpec(site="service.submit", kind="corrupt")))
+    code, body = state.submit(b"tx2", 5)
+    assert (code, body["shed_reason"]) == (400, "corrupt")
+    assert state.mempool.depth() == 1
+    injection.disarm()
+    # partial: admitted, receipt lost — recoverable through tx_status.
+    injection.arm(_plan(FaultSpec(site="service.submit", kind="partial")))
+    code, body = state.submit(b"tx3", 5)
+    assert (code, body) == (200, None)
+    injection.disarm()
+    code, body = state.tx_status(txid_of(b"tx3"))
+    assert (code, body["status"]) == (200, PENDING)
+
+
+def test_deadline_burned_inside_admission_sheds_typed():
+    # A clock that leaps 10s per call: the request burns its whole
+    # budget inside admission (the injected-hang shape) and must be
+    # dropped BEFORE the miner, with a typed reason.
+    t = [0.0]
+
+    def clock():
+        t[0] += 10.0
+        return t[0]
+
+    state = _state(clock=clock, deadline_s=5.0)
+    code, body = state.submit(b"tx", 5)
+    assert (code, body["shed_reason"]) == (503, "deadline")
+    assert state.mempool.depth() == 0
+
+
+def test_heartbeat_gate_flips_and_recovers():
+    t = [0.0]
+    state = _state(clock=lambda: t[0], stall_s=1.0)
+    # starting grace: no heartbeat ever, uptime inside the budget.
+    assert state.accept_gate() == (True, None)
+    # grace elapsed with still no heartbeat: the door closes typed.
+    t[0] = 5.0
+    ok, reason = state.accept_gate()
+    assert (ok, reason) == (False, "miner_stalled")
+    code, body = state.submit(b"tx", 5)
+    assert (code, body["shed_reason"]) == (503, "miner_stalled")
+    # a fresh miner heartbeat reopens the door (age ~0 < stall budget).
+    telemetry.heartbeat("miner_heartbeat").set(1)
+    assert state.accept_gate() == (True, None)
+    code, body = state.submit(b"tx", 5)
+    assert (code, body["result"]) == (200, "accepted")
+
+
+def test_service_sites_registered_all_kinds_constructible():
+    assert "service.submit" in SITES and "service.rebuild" in SITES
+    for site in ("service.submit", "service.rebuild"):
+        for kind in KINDS:
+            FaultSpec(site=site, kind=kind)   # no FaultPlanError
+
+
+# ---- the HTTP door end to end -------------------------------------------
+
+
+def _post(base, doc, timeout=10):
+    req = urllib.request.Request(
+        base + "/submit", data=json.dumps(doc).encode(), method="POST",
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read() or b"{}")
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _get(base, path, timeout=10):
+    try:
+        with urllib.request.urlopen(base + path, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_http_door_serves_submit_mine_status_chain():
+    from mpi_blockchain_tpu.perfwatch.server import wait_listening
+
+    cfg = _cfg(difficulty_bits=10, n_blocks=2)
+    miner = Miner(cfg, backend=CpuBackend())
+    pool = Mempool(cap=4)
+    state = install_service(miner, port=0, mempool=pool,
+                            feed=TemplateFeed(pool, cfg, max_txs=4))
+    try:
+        assert wait_listening("127.0.0.1", state.server.port)
+        base = f"http://127.0.0.1:{state.server.port}"
+        code, body = _post(base, {"payload": "tx-hello", "fee": 9})
+        assert (code, body["result"]) == (200, "accepted")
+        tid = body["txid"]
+        assert tid == txid_of(b"tx-hello")
+        # idempotent resubmission.
+        code, body = _post(base, {"payload": "tx-hello", "fee": 9})
+        assert body["result"] == "duplicate"
+        # the live template embeds the pending tx, undegraded.
+        code, tmpl = _get(base, "/template")
+        assert tid in tmpl["txids"] and tmpl["degraded"] is False
+        # mined into the chain: status flips to included with a height.
+        miner.mine_chain(cfg.n_blocks)
+        code, st = _get(base, f"/tx_status?txid={tid}")
+        assert (code, st["status"]) == (200, INCLUDED)
+        assert st["height"] == 1
+        code, chain = _get(base, f"/chain?n={cfg.n_blocks}")
+        assert chain["height"] == cfg.n_blocks
+        assert chain["tip_hash"] == miner.node.tip_hash.hex()
+        assert len(chain["blocks"]) == cfg.n_blocks
+        # unknown txid answers typed, not 500.
+        code, miss = _get(base, "/tx_status?txid=feed")
+        assert (code, miss["error"]) == (404, "unknown_txid")
+        # the inherited /healthz carries the additive service stats.
+        code, health = _get(base, "/healthz")
+        assert health["service"]["mempool"]["included_total"] == 1
+        # malformed submit answers 400 typed.
+        code, bad = _post(base, {"fee": 1})
+        assert (code, bad["error"]) == (400, "bad_request")
+    finally:
+        uninstall_service(state)
+    # unbind restored the serviceless seam and disarmed the stats.
+    assert service_stats() == {}
+    assert "payload_for" not in miner.__dict__
+
+
+def test_install_service_binds_seam_and_stats():
+    miner = Miner(_cfg(), backend=CpuBackend())
+    assert service_stats() == {}
+    state = install_service(miner, port=0)
+    try:
+        assert active_service() is state
+        stats = service_stats()
+        assert stats["mempool"]["depth"] == 0
+        assert stats["accept_gate"]["open"] is True
+        assert stats["degraded"] is False
+        assert miner.payload_for == state.feed.payload_for
+    finally:
+        uninstall_service(state)
+        uninstall_service(state)    # idempotent
+
+
+# ---- loadgen determinism ------------------------------------------------
+
+
+def test_loadgen_schedule_is_seed_deterministic():
+    from mpi_blockchain_tpu.service.loadgen import requests_for_seed
+
+    a = requests_for_seed(1337, 16)
+    assert a == requests_for_seed(1337, 16)
+    assert a != requests_for_seed(1338, 16)
+    assert len(a) == 16
+    assert len({r["payload"] for r in a}) == 16     # unique payloads
+    assert all(1 <= r["fee"] <= 1000 for r in a)
+
+
+# ---- the serve bench section + absolute bound ---------------------------
+
+
+def test_serve_bench_payload_gated_by_absolute_bound(tmp_path):
+    from mpi_blockchain_tpu.perfwatch.detector import (SECTION_BOUNDS,
+                                                       check_candidate)
+    from mpi_blockchain_tpu.perfwatch.history import HistoryStore
+
+    assert SECTION_BOUNDS["serve"] == 2000.0
+    store = HistoryStore(tmp_path / "PERF_HISTORY.jsonl")
+    payload = {"backend": "cpu", "difficulty_bits": 12, "n_blocks": 6,
+               "requests_per_sec": 500.0, "p99_latency_ms": 12.5,
+               "shed_fraction": 0.25, "mempool_depth_max": 8}
+    ok = check_candidate(store, "serve", payload)
+    assert (ok.verdict, ok.basis) == ("ok", "absolute-bound")
+    assert ok.key == "serve/cpu/d12/n6"
+    bad = check_candidate(store, "serve",
+                          {**payload, "p99_latency_ms": 2500.0})
+    assert bad.verdict == "regression"
+
+
+def test_committed_history_serve_entry_present_and_in_budget():
+    from mpi_blockchain_tpu.perfwatch.detector import check_history
+    from mpi_blockchain_tpu.perfwatch.history import (DEFAULT_HISTORY_NAME,
+                                                      HistoryStore)
+
+    store = HistoryStore(REPO / DEFAULT_HISTORY_NAME)
+    serve = store.entries("serve")
+    assert serve, "PERF_HISTORY.jsonl lacks the serve section"
+    findings = [f for f in check_history(store) if f.section == "serve"]
+    assert findings and all(f.verdict != "regression" for f in findings)
+
+
+# ---- chainwatch saturation rule -----------------------------------------
+
+
+def test_mempool_saturation_rule_quiet_without_service():
+    from mpi_blockchain_tpu.chainwatch.rules import MempoolSaturation
+
+    r = MempoolSaturation()
+    for _ in range(6):
+        assert r.evaluate({}) is None   # serviceless: never fires
+
+
+def test_mempool_saturation_rule_fires_on_full_pool(monkeypatch):
+    import mpi_blockchain_tpu.service as service_mod
+    from mpi_blockchain_tpu.chainwatch.rules import MempoolSaturation
+
+    monkeypatch.setattr(service_mod, "service_stats", lambda: {
+        "mempool": {"depth": 8, "cap": 8},
+        "shed_total": {"mempool_full": 0},
+        "accept_gate": {"open": True}})
+    r = MempoolSaturation()
+    assert r.name == "mempool_saturation"
+    assert r.evaluate({}) is None          # debounce sample 1
+    detail = r.evaluate({})                # debounce sample 2: fires
+    assert detail is not None
+    assert detail["depth"] == 8 and detail["cap"] == 8
+
+
+def test_mempool_saturation_rule_fires_on_shed_rate(monkeypatch):
+    import mpi_blockchain_tpu.service as service_mod
+    from mpi_blockchain_tpu.chainwatch.rules import MempoolSaturation
+
+    shed = [0]
+    monkeypatch.setattr(service_mod, "service_stats", lambda: {
+        "mempool": {"depth": 0, "cap": 8},
+        "shed_total": {"mempool_full": shed[0]},
+        "accept_gate": {"open": True}})
+    r = MempoolSaturation()
+    assert r.evaluate({}) is None          # primes the delta baseline
+    shed[0] = 6                            # +6 sheds >= the default 5
+    assert r.evaluate({}) is None          # breach 1 (debounce)
+    shed[0] = 12
+    detail = r.evaluate({})                # breach 2: fires
+    assert detail is not None
+    assert detail["shed_delta"] == 6
